@@ -1,0 +1,22 @@
+"""matcha_tpu — a TPU-native framework for decentralized data-parallel SGD
+over arbitrary peer-to-peer topologies (D-PSGD, MATCHA, CHOCO-SGD).
+
+Capability parity target: the MATCHA reference reproduction at
+``/root/reference`` (SZU-AdvTech-2023/270), re-designed TPU-first:
+
+* N virtual workers live as rows of sharded ``[N, ...]`` arrays over a
+  ``jax.sharding.Mesh`` axis — one SPMD program, not N MPI processes.
+* Gossip averaging is a static set of permutations (one per matching)
+  selected per step by a precomputed activation-flag stream, compiled by XLA
+  into collective-permutes over ICI instead of mpi4py ``sendrecv``.
+* The MATCHA scheduling math (matching decomposition + two convex solves)
+  stays host-side at setup, exactly as in the reference, and emits a
+  compile-time contract: ``perms[M,N]``, ``alpha``, ``probs[M]``,
+  ``flags[T,M]``.
+"""
+
+__version__ = "0.1.0"
+
+from . import topology  # noqa: F401
+
+__all__ = ["topology"]

@@ -1,0 +1,6 @@
+"""Native C++ accelerators (built lazily; Python fallbacks exist)."""
+
+def native_decompose_greedy(edges, size, seed):
+    """Placeholder until the C++ decomposer lands; returning None selects the
+    pure-Python fallback in topology.decompose."""
+    return None

@@ -1,0 +1,138 @@
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+
+
+ZOO_IDS = [0, 1, 2, 3, 4, 5]
+
+
+@pytest.mark.parametrize("gid", ZOO_IDS)
+def test_zoo_graphs_are_valid_decompositions(gid):
+    size = tp.graph_size(gid)
+    decomposed = tp.select_graph(gid)
+    tp.validate_decomposition(decomposed, size)
+    edges = tp.union_edges(decomposed)
+    assert tp.is_connected(edges, size)
+
+
+def test_zoo_matching_counts():
+    # matches the reference zoo (util.py:275-342): 5/5/10/13/8/2 matchings
+    for gid, m in {0: 5, 1: 5, 2: 10, 3: 13, 4: 8, 5: 2}.items():
+        assert len(tp.select_graph(gid)) == m
+
+
+@pytest.mark.parametrize("kind", tp.available_topologies())
+def test_generators_produce_connected_graphs(kind):
+    n = 16
+    edges = tp.make_graph(kind, n, seed=3)
+    assert edges, kind
+    assert tp.is_connected(edges, n)
+    # no self loops / duplicates
+    keys = [(min(u, v), max(u, v)) for u, v in edges]
+    assert len(keys) == len(set(keys))
+    assert all(u != v for u, v in edges)
+
+
+@pytest.mark.parametrize("method", ["extract", "greedy"])
+@pytest.mark.parametrize(
+    "edges,size",
+    [
+        (tp.ring_graph(8), 8),
+        (tp.hypercube_graph(16), 16),
+        (tp.erdos_renyi_graph(12, 0.4, seed=7), 12),
+        (tp.union_edges(tp.select_graph(2)), 16),
+        (tp.complete_graph(6), 6),
+    ],
+)
+def test_decompose_valid(method, edges, size):
+    decomposed = tp.decompose(edges, size, method=method, seed=11)
+    tp.validate_decomposition(decomposed, size, base_edges=edges)
+
+
+def test_decompose_deterministic_given_seed():
+    edges = tp.erdos_renyi_graph(14, 0.4, seed=2)
+    a = tp.decompose(edges, 14, method="extract", seed=5)
+    b = tp.decompose(edges, 14, method="extract", seed=5)
+    assert a == b
+    c = tp.decompose(edges, 14, method="greedy", seed=5)
+    d = tp.decompose(edges, 14, method="greedy", seed=5)
+    assert c == d
+
+
+def test_decompose_ring_is_two_matchings():
+    edges = tp.ring_graph(8)
+    decomposed = tp.decompose(edges, 8, method="extract", seed=0)
+    assert len(decomposed) == 2
+
+
+def test_decompose_rejects_bad_input():
+    with pytest.raises(ValueError):
+        tp.decompose([(0, 0)], 4)
+    with pytest.raises(ValueError):
+        tp.decompose([(0, 1), (1, 0)], 4)
+
+
+def test_matchings_to_perms_involution():
+    decomposed = tp.select_graph(0)
+    size = 8
+    perms = tp.matchings_to_perms(decomposed, size)
+    assert perms.shape == (5, 8)
+    for row in perms:
+        # involution: perm[perm[i]] == i
+        assert np.array_equal(row[row], np.arange(size))
+    # back-conversion to the reference -1 convention
+    nbrs = tp.perms_to_neighbors(perms)
+    # matching 0 of graph 0 is perfect on 8 nodes: nobody unmatched
+    assert (nbrs[0] >= 0).all()
+    # matching 4 is the single edge (3,1)
+    assert nbrs[4][1] == 3 and nbrs[4][3] == 1
+    assert (nbrs[4][[0, 2, 4, 5, 6, 7]] == -1).all()
+
+
+def test_laplacian_properties():
+    gid = 0
+    size = 8
+    decomposed = tp.select_graph(gid)
+    Ls = tp.matching_laplacians(decomposed, size)
+    assert Ls.shape == (5, 8, 8)
+    for L in Ls:
+        assert np.allclose(L, L.T)
+        assert np.allclose(L.sum(axis=1), 0)
+        assert np.linalg.eigvalsh(L)[0] >= -1e-9
+    L_base = tp.base_laplacian(decomposed, size)
+    assert tp.algebraic_connectivity(L_base) > 0
+
+
+def test_spectral_gap_alpha_matches_closed_form():
+    # ring of 8: eigenvalues of L are 2-2cos(2πk/8)
+    edges = tp.ring_graph(8)
+    L = tp.edge_laplacian(edges, 8)
+    lam = 2 - 2 * np.cos(2 * np.pi * np.arange(8) / 8)
+    lam.sort()
+    expect = 2.0 / (lam[1] + lam[-1])
+    assert tp.spectral_gap_alpha(L) == pytest.approx(expect, rel=1e-9)
+    with pytest.raises(ValueError):
+        tp.spectral_gap_alpha(tp.edge_laplacian([(0, 1), (2, 3)], 4))  # disconnected
+
+
+def test_mixing_matrix_doubly_stochastic():
+    decomposed = tp.select_graph(4)
+    size = 16
+    Ls = tp.matching_laplacians(decomposed, size)
+    alpha = tp.spectral_gap_alpha(Ls.sum(0))
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        flags = rng.integers(0, 2, size=len(decomposed))
+        W = tp.mixing_matrix(Ls, flags, alpha)
+        assert np.allclose(W.sum(axis=0), 1)
+        assert np.allclose(W.sum(axis=1), 1)
+        assert np.allclose(W, W.T)
+
+
+def test_expected_contraction_rate_sane():
+    decomposed = tp.select_graph(5)  # 8-ring
+    Ls = tp.matching_laplacians(decomposed, 8)
+    alpha = tp.spectral_gap_alpha(Ls.sum(0))
+    rho = tp.expected_contraction_rate(Ls, np.ones(2), alpha)
+    assert 0 < rho < 1  # always-on ring must contract
