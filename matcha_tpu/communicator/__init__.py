@@ -1,0 +1,40 @@
+"""Communicator layer: per-iteration consensus transforms
+(decen / choco / centralized / none), jit- and scan-compatible."""
+
+from typing import Optional
+
+from .base import Communicator
+from .centralized import make_centralized, make_none
+from .choco import make_choco
+from .decen import make_decen
+
+__all__ = [
+    "Communicator",
+    "make_centralized",
+    "make_choco",
+    "make_decen",
+    "make_none",
+    "select_communicator",
+]
+
+
+def select_communicator(
+    name: str,
+    schedule=None,
+    mesh=None,
+    ratio: float = 0.9,
+    consensus_lr: float = 0.1,
+    backend: str = "auto",
+) -> Communicator:
+    """Registry keyed by the reference's algorithm names (README.md:17-53):
+    ``decen`` (D-PSGD/MATCHA), ``choco`` (CHOCO-SGD), ``centralized``
+    (AllReduce baseline), ``none``."""
+    if name == "decen":
+        return make_decen(schedule, mesh=mesh, backend=backend)
+    if name == "choco":
+        return make_choco(schedule, ratio=ratio, consensus_lr=consensus_lr)
+    if name == "centralized":
+        return make_centralized()
+    if name == "none":
+        return make_none()
+    raise KeyError(f"unknown communicator '{name}'")

@@ -1,0 +1,52 @@
+"""Communicator interface: the per-iteration consensus transform.
+
+The reference's plugin seam (SURVEY.md §1) is ``communicator.communicate(model)``
+— a stateful object mutating torch parameters over MPI.  The TPU-native form
+is a *pure function pair* compatible with ``jit``/``scan``:
+
+    carry0      = comm.init(flat0)                  # [N, D] -> carry pytree
+    flat', c'   = comm.step(flat, carry, flags_t)   # one gossip iteration
+
+``flat`` is the ``[N, D]`` stack of all workers' flattened parameters,
+``flags_t`` the ``f32[M]`` activation row for this step.  Carries hold
+persistent algorithm state (CHOCO's ``x_hat``/``s``) so checkpointing them is
+trivial — the state the reference would silently lose on restart
+(SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax
+
+__all__ = ["Communicator"]
+
+StepFn = Callable[[jax.Array, Any, jax.Array], Tuple[jax.Array, Any]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Communicator:
+    """A named (init, step) pair; ``step`` must be jit/scan-compatible."""
+
+    name: str
+    init: Callable[[jax.Array], Any]
+    step: StepFn
+
+    def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None):
+        """Scan the communicator over a whole flag stream (consensus-only runs,
+        tests, and the gossip micro-benchmark)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if carry is None:
+            carry = self.init(flat)
+
+        def body(state, flags_t):
+            x, c = state
+            x, c = self.step(x, c, flags_t)
+            return (x, c), None
+
+        (x, c), _ = lax.scan(body, (flat, carry), jnp.asarray(flags, jnp.float32))
+        return x, c

@@ -1,0 +1,39 @@
+"""Centralized (fully-synchronous AllReduce) communicator and the no-comm
+baseline.
+
+Counterparts of ``centralizedCommunicator`` (communicator.py:46-76) and of
+running with communication disabled.  On the worker axis an AllReduce-average
+is a mean over rows — XLA emits the actual all-reduce collective when the
+axis is sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..parallel import allreduce_mean
+from .base import Communicator
+
+__all__ = ["make_centralized", "make_none"]
+
+
+def make_centralized() -> Communicator:
+    def init(flat: jax.Array):
+        return ()
+
+    def step(flat: jax.Array, carry, flags_t: jax.Array):
+        return allreduce_mean(flat), carry
+
+    return Communicator(name="centralized", init=init, step=step)
+
+
+def make_none() -> Communicator:
+    """Fully-local training (no consensus) — ablation baseline."""
+
+    def init(flat: jax.Array):
+        return ()
+
+    def step(flat: jax.Array, carry, flags_t: jax.Array):
+        return flat, carry
+
+    return Communicator(name="none", init=init, step=step)

@@ -1,0 +1,75 @@
+"""CHOCO-SGD communicator: gossip on top-k-compressed model differences.
+
+TPU-native re-design of ``ChocoCommunicator``
+(/root/reference/communicator.py:161-268).  Reference semantics, batched over
+the worker axis with on-device compression (no host round-trips):
+
+    q_i           = compress(x_i − x̂_i)            (top-k keeps 1−ratio)
+    s_i          += Σ_{j active, partnered} α·scatter(q_{π_j(i)})
+    s_i          += (1 − d_i·α)·scatter(q_i)
+    x̂_i          += scatter(q_i)
+    x_i          += γ·(s_i − x̂_i)                   (γ = consensus_lr)
+
+Persistent carry = {x̂, s} — zero-initialized like the reference's lazy init
+(communicator.py:179-182), never decayed (quirk Q4, kept deliberately).
+Skipped iterations (all flags 0) leave *all* state untouched, matching the
+reference's early return (communicator.py:249-250) — implemented by scaling
+every update by an ``any_active`` mask so the compiled program stays static.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import batched_top_k, scatter_rows
+from ..schedule import Schedule
+from .base import Communicator
+
+__all__ = ["make_choco"]
+
+
+def make_choco(
+    schedule: Schedule,
+    ratio: float = 0.9,
+    consensus_lr: float = 0.1,
+) -> Communicator:
+    """Build the CHOCO communicator.
+
+    ``ratio`` follows reference semantics: keep the top ``1−ratio`` fraction
+    (0.9 ⇒ ~10%; hard-coded at the reference call site train_mpi.py:79 —
+    here a real parameter).  ``consensus_lr`` is γ (default matches
+    train_mpi.py:228).
+    """
+    perms = np.asarray(schedule.perms)
+    alpha = float(schedule.alpha)
+    M, N = perms.shape
+    # partner masks: fixed points exchange nothing (communicator.py:210)
+    partnered = (perms != np.arange(N)[None, :]).astype(np.float32)  # [M, N]
+
+    def init(flat: jax.Array):
+        return {"x_hat": jnp.zeros_like(flat), "s": jnp.zeros_like(flat)}
+
+    def step(flat: jax.Array, carry, flags_t: jax.Array):
+        x_hat, s = carry["x_hat"], carry["s"]
+        active = (jnp.sum(flags_t) > 0).astype(flat.dtype)  # 0 ⇒ frozen step
+
+        vals, idx = batched_top_k(flat - x_hat, ratio)  # [N, k] each
+
+        # neighbor messages: worker i receives (vals, idx)[π_j(i)] per active j
+        for j in range(M):
+            pi = perms[j]
+            if not partnered[j].any():
+                continue
+            scale = active * flags_t[j] * alpha * jnp.asarray(partnered[j])  # [N]
+            s = scatter_rows(s, idx[pi], vals[pi], scale)
+
+        # self message with per-worker weight 1 − d_i·α (d = active degree)
+        deg = jnp.asarray(partnered.T) @ flags_t  # [N]
+        s = scatter_rows(s, idx, vals, active * (1.0 - deg * alpha))
+        x_hat = scatter_rows(x_hat, idx, vals, active)
+        flat = flat + active * consensus_lr * (s - x_hat)
+        return flat, {"x_hat": x_hat, "s": s}
+
+    return Communicator(name=f"choco[r{ratio}]", init=init, step=step)

@@ -1,0 +1,22 @@
+"""Device-level primitive ops: batched flatten/unflatten, compressors."""
+
+from .compress import (
+    batched_random_k,
+    batched_top_k,
+    dense_from_sparse,
+    scatter_rows,
+    select_compressor,
+    top_k_ratio_size,
+)
+from .flatten import WorkerFlattener, make_flattener
+
+__all__ = [
+    "WorkerFlattener",
+    "batched_random_k",
+    "batched_top_k",
+    "dense_from_sparse",
+    "make_flattener",
+    "scatter_rows",
+    "select_compressor",
+    "top_k_ratio_size",
+]

@@ -1,0 +1,65 @@
+"""Batched pytree flatten/unflatten over the worker axis.
+
+TPU-native counterpart of the reference's ``flatten_tensors`` /
+``unflatten_tensors`` (/root/reference/comm_helpers.py:12-56): the gossip
+wire format is one flat ``[D]`` vector per worker.  Here all N workers'
+parameters live in a single pytree whose leaves carry a leading worker axis
+``[N, ...]``; flattening reshapes and concatenates along the trailing dims to
+``[N, D]`` — a layout change XLA folds into the surrounding program rather
+than a host-side copy loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["WorkerFlattener", "make_flattener"]
+
+
+class WorkerFlattener:
+    """Bidirectional ``pytree[N, ...] <-> [N, D]`` mapping with static layout."""
+
+    def __init__(self, template: Any):
+        """``template``: a pytree whose leaves are ``[N, ...]`` arrays (the
+        per-worker parameter stack).  The layout (treedef, shapes, dtypes) is
+        captured once and reused for every step."""
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        if not leaves:
+            raise ValueError("empty pytree")
+        n = leaves[0].shape[0]
+        for leaf in leaves:
+            if leaf.ndim < 1 or leaf.shape[0] != n:
+                raise ValueError(
+                    f"every leaf needs leading worker axis {n}; got {leaf.shape}"
+                )
+        self.treedef = treedef
+        self.num_workers = int(n)
+        self.shapes = [tuple(leaf.shape[1:]) for leaf in leaves]
+        self.dtypes = [leaf.dtype for leaf in leaves]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.offsets = np.concatenate([[0], np.cumsum(self.sizes)]).astype(np.int64)
+        self.dim = int(self.offsets[-1])
+
+    def flatten(self, tree: Any) -> jax.Array:
+        """pytree of ``[N, ...]`` leaves → ``f32[N, D]`` (gossip wire dtype)."""
+        leaves = self.treedef.flatten_up_to(tree)
+        flat = [leaf.reshape(leaf.shape[0], -1).astype(jnp.float32) for leaf in leaves]
+        return jnp.concatenate(flat, axis=1)
+
+    def unflatten(self, flat: jax.Array) -> Any:
+        """``[N, D]`` → pytree, restoring original shapes and dtypes."""
+        if flat.ndim != 2 or flat.shape[1] != self.dim:
+            raise ValueError(f"expected [N, {self.dim}], got {flat.shape}")
+        leaves = []
+        for i, (shape, dtype) in enumerate(zip(self.shapes, self.dtypes)):
+            seg = jax.lax.slice_in_dim(flat, int(self.offsets[i]), int(self.offsets[i + 1]), axis=1)
+            leaves.append(seg.reshape((flat.shape[0],) + shape).astype(dtype))
+        return self.treedef.unflatten(leaves)
+
+
+def make_flattener(template: Any) -> WorkerFlattener:
+    return WorkerFlattener(template)

@@ -1,0 +1,27 @@
+"""Device-parallel layer: worker mesh, gossip backends, collectives."""
+
+from .collectives import allreduce_mean, broadcast_worker0, worker_disagreement
+from .gossip import (
+    FoldedPlan,
+    build_folded_plan,
+    gossip_mix,
+    gossip_mix_folded,
+    shard_map_gossip_fn,
+)
+from .mesh import WORKER_AXIS, fold_dims, replicated, shard_workers, worker_mesh
+
+__all__ = [
+    "WORKER_AXIS",
+    "FoldedPlan",
+    "allreduce_mean",
+    "broadcast_worker0",
+    "build_folded_plan",
+    "fold_dims",
+    "gossip_mix",
+    "gossip_mix_folded",
+    "replicated",
+    "shard_map_gossip_fn",
+    "shard_workers",
+    "worker_mesh",
+    "worker_disagreement",
+]

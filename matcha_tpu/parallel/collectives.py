@@ -1,0 +1,36 @@
+"""Centralized collectives over the worker axis.
+
+TPU-native equivalents of the reference's MPI AllReduce paths:
+``centralizedCommunicator.averaging`` (communicator.py:56-67) and the one-time
+init sync ``sync_allreduce`` (train_mpi.py:34-56).  On a ``[N, ...]`` worker
+array the global average is just a mean over the leading axis — XLA lowers it
+to ``all-reduce`` over ICI when the axis is sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["allreduce_mean", "broadcast_worker0", "worker_disagreement"]
+
+
+def allreduce_mean(x: jax.Array) -> jax.Array:
+    """Replace every worker's row with the global average (AllReduce/size)."""
+    mean = jnp.mean(x, axis=0, keepdims=True)
+    return jnp.broadcast_to(mean, x.shape)
+
+
+def broadcast_worker0(x: jax.Array) -> jax.Array:
+    """Replace every worker's row with worker 0's (init-consensus alternative)."""
+    return jnp.broadcast_to(x[0:1], x.shape)
+
+
+def worker_disagreement(x: jax.Array) -> jax.Array:
+    """RMS distance of worker rows from consensus: ‖x − x̄‖ / √(N·D).
+
+    The quantity the contraction bound ρ controls; the reference never
+    measures it (SURVEY.md §5.5) — we expose it as a first-class metric.
+    """
+    centered = x - jnp.mean(x, axis=0, keepdims=True)
+    return jnp.sqrt(jnp.mean(centered * centered))

@@ -1,0 +1,191 @@
+"""Gossip averaging — the performance-critical device primitive.
+
+This is the TPU-native replacement for the reference's per-iteration MPI
+exchange loop (``decenCommunicator.averaging``,
+/root/reference/communicator.py:92-122): each rank's blocking
+``sendrecv`` per active matching becomes a *static permutation* of the
+worker axis, and the weighted accumulation becomes a fused multiply-add —
+one XLA program, no host round-trips, no barriers (SPMD lockstep).
+
+One gossip step with matchings ``π_j`` (involutions over workers, fixed
+points = unmatched) and per-step weights ``w_j = α·flag_j``:
+
+    x_i ← x_i + Σ_j w_j · (x_{π_j(i)} − x_i)
+
+which equals the reference's ``(1 − deg·α)·x_i + α·Σ_active x_partner``
+because fixed points contribute zero delta.
+
+Backends
+--------
+``gossip_mix``
+    Gather form on a ``[N, ...]`` array.  Works for any N on any mesh under
+    ``jit`` (XLA partitions the static gathers); also the single-chip
+    simulation fast path, where every permutation is chip-local.
+
+``gossip_mix_folded`` (+ ``build_folded_plan``)
+    Explicit ``shard_map`` form for N virtual workers folded onto C chips
+    (``L = N/C`` rows per chip).  Each matching is decomposed at trace time
+    into chip-offset groups: offset 0 edges are local row gathers; each
+    distinct offset ``d ≠ 0`` costs one ``lax.ppermute`` of the ``[L, ...]``
+    block around the ring — riding ICI, deadlock-free by construction
+    (SURVEY.md Q3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .mesh import WORKER_AXIS
+
+__all__ = [
+    "gossip_mix",
+    "FoldedPlan",
+    "build_folded_plan",
+    "gossip_mix_folded",
+    "shard_map_gossip_fn",
+]
+
+
+def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.Array:
+    """``x_i + Σ_j weights[j]·(x[π_j(i)] − x_i)`` over the leading axis.
+
+    ``perms`` must be a *static* numpy ``int32[M, N]`` (part of the compiled
+    program — this is what lets XLA lower each gather to a shuffle /
+    collective-permute instead of a dynamic gather).  ``weights`` is a traced
+    ``[M]`` vector, typically ``alpha * flags[t]`` — masking keeps the
+    communication pattern static across steps so nothing recompiles
+    (SURVEY.md §7 "per-step flag-dependent communication").
+    """
+    perms = np.asarray(perms)
+    if perms.ndim != 2 or perms.shape[1] != x.shape[0]:
+        raise ValueError(f"perms {perms.shape} incompatible with x {x.shape}")
+    acc = jnp.zeros_like(x)
+    for j in range(perms.shape[0]):
+        pi = perms[j]
+        if np.all(pi == np.arange(pi.shape[0])):
+            continue  # empty matching: zero delta regardless of flag
+        acc = acc + weights[j] * (x[pi] - x)
+    return x + acc
+
+
+# ---------------------------------------------------------------------------
+# Folded shard_map backend
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _OffsetPart:
+    """Edges of one matching whose partner sits ``offset`` chips away."""
+
+    offset: int
+    src_local: np.ndarray  # int32[C, L] — partner's row within its chip's block
+    mask: np.ndarray  # f32[C, L] — 1 where this offset applies
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldedPlan:
+    """Trace-time constant: per-matching chip-offset decomposition."""
+
+    num_chips: int
+    rows_per_chip: int
+    matchings: Tuple[Tuple[_OffsetPart, ...], ...]
+
+    @property
+    def num_matchings(self) -> int:
+        return len(self.matchings)
+
+    @property
+    def offsets_used(self) -> List[List[int]]:
+        return [[p.offset for p in m] for m in self.matchings]
+
+
+def build_folded_plan(perms: np.ndarray, num_chips: int) -> FoldedPlan:
+    """Split each matching permutation into intra-chip and inter-chip parts.
+
+    Workers are laid out ``g = c*L + l`` (chip-major).  For each matching and
+    each distinct chip offset ``d = (chip(π(g)) − chip(g)) mod C`` we emit a
+    selection table: receiver chip ``c`` picks row ``π(g) mod L`` out of the
+    block arriving from chip ``(c+d) mod C``.  Because π is a total involution
+    (fixed points map to themselves at offset 0), the masks of all parts
+    partition every slot — so the combined gather is exactly ``x[π]``.
+    """
+    perms = np.asarray(perms, dtype=np.int64)
+    M, N = perms.shape
+    C = int(num_chips)
+    if N % C:
+        raise ValueError(f"N={N} not divisible by num_chips={C}")
+    L = N // C
+    g = np.arange(N)
+    matchings = []
+    for j in range(M):
+        p = perms[j]
+        d_all = ((p // L) - (g // L)) % C  # [N]
+        parts = []
+        for d in sorted(set(int(v) for v in d_all)):
+            sel = d_all == d
+            src = np.where(sel, p % L, 0).reshape(C, L).astype(np.int32)
+            mask = sel.astype(np.float32).reshape(C, L)
+            parts.append(_OffsetPart(int(d), src, mask))
+        matchings.append(tuple(parts))
+    return FoldedPlan(C, L, tuple(matchings))
+
+
+def _bshape(mask_row: jax.Array, x_blk: jax.Array) -> jax.Array:
+    """Broadcast a [L] mask over the trailing dims of [L, ...]."""
+    return mask_row.reshape(mask_row.shape + (1,) * (x_blk.ndim - 1))
+
+
+def gossip_mix_folded(
+    x_blk: jax.Array,
+    plan: FoldedPlan,
+    weights: jax.Array,
+    axis: str = WORKER_AXIS,
+) -> jax.Array:
+    """Per-chip body of the folded gossip step; call inside ``shard_map``.
+
+    ``x_blk``: this chip's ``[L, ...]`` block of the ``[N, ...]`` worker array.
+    One ``ppermute`` per (matching, nonzero offset); offset-0 edges are local
+    row gathers.  Weights mask inactive matchings (communication is static).
+    """
+    C = plan.num_chips
+    c = lax.axis_index(axis)
+    acc = jnp.zeros_like(x_blk)
+    for j, parts in enumerate(plan.matchings):
+        gathered = jnp.zeros_like(x_blk)
+        for part in parts:
+            if part.offset == 0:
+                y = x_blk
+            else:
+                pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
+                y = lax.ppermute(x_blk, axis, pairs)
+            src = jnp.asarray(part.src_local)[c]  # [L]
+            m = jnp.asarray(part.mask)[c]  # [L]
+            gathered = gathered + _bshape(m, x_blk) * y[src]
+        # masks partition all L slots, so `gathered` == x[π_j] for this block
+        acc = acc + weights[j] * (gathered - x_blk)
+    return x_blk + acc
+
+
+def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS):
+    """Build a jittable ``(x[N,...], weights[M]) -> x[N,...]`` gossip function
+    running as an explicit shard_map over ``mesh``."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    C = mesh.shape[axis]
+    plan = build_folded_plan(np.asarray(perms), C)
+
+    def body(x_blk, weights):
+        return gossip_mix_folded(x_blk, plan, weights, axis=axis)
+
+    def fn(x, weights):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return shard_map(body, mesh=mesh, in_specs=(spec, P()), out_specs=spec)(x, weights)
+
+    return fn

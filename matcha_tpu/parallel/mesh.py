@@ -1,0 +1,62 @@
+"""Device mesh helpers for the virtual-worker axis.
+
+The framework's parallelism model (SURVEY.md §2.6): decentralized data
+parallelism as **one mesh axis of N virtual workers**.  N may exceed the
+physical chip count C; workers are then *folded* — each chip carries
+``L = N // C`` consecutive worker rows, and gossip edges are split into
+intra-chip gathers and inter-chip collective permutes (see
+``gossip.build_folded_plan``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+
+__all__ = ["WORKER_AXIS", "worker_mesh", "shard_workers", "replicated", "fold_dims"]
+
+
+def worker_mesh(
+    num_devices: int | None = None,
+    axis: str = WORKER_AXIS,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """1-D mesh over (a prefix of) the available devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(f"asked for {num_devices} devices, have {len(devs)}")
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def fold_dims(num_workers: int, mesh: Mesh, axis: str = WORKER_AXIS) -> tuple[int, int]:
+    """``(C, L)``: chips and workers-per-chip for folding N workers onto the mesh."""
+    C = mesh.shape[axis]
+    if num_workers % C:
+        raise ValueError(
+            f"num_workers={num_workers} must be divisible by mesh axis size {C}"
+        )
+    return C, num_workers // C
+
+
+def shard_workers(x, mesh: Mesh, axis: str = WORKER_AXIS):
+    """Place ``[N, ...]`` arrays with the leading axis sharded over the mesh."""
+    def put(a):
+        spec = P(axis, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, x)
+
+
+def replicated(x, mesh: Mesh):
+    """Replicate small arrays (flags, step counters) across the mesh."""
+    def put(a):
+        return jax.device_put(a, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(put, x)

@@ -1,0 +1,23 @@
+"""Gossip scheduling layer: turns a topology + budget into the static
+compile-time contract (perms, alpha, probs, flags) consumed by device code."""
+
+from .base import Schedule, sample_flags
+from .fixed import fixed_schedule
+from .matcha import matcha_schedule
+from .solvers import (
+    contraction_rho,
+    project_box_capped_sum,
+    solve_activation_probabilities,
+    solve_mixing_weight,
+)
+
+__all__ = [
+    "Schedule",
+    "sample_flags",
+    "fixed_schedule",
+    "matcha_schedule",
+    "contraction_rho",
+    "project_box_capped_sum",
+    "solve_activation_probabilities",
+    "solve_mixing_weight",
+]

@@ -1,0 +1,122 @@
+"""The compile-time schedule contract between host-side planning and device code.
+
+Everything the reference's ``GraphProcessor`` family exposes to its
+communicators (``/root/reference/graph_manager.py`` → ``communicator.py:84,
+103,135``: ``neighbor_weight``, ``active_flags``, ``neighbors_info``) is
+captured here as four static arrays — which is all XLA ever needs to compile
+the gossip step into a fixed set of collective permutes:
+
+    perms : int32[M, N]   matching involutions (partner or self)
+    alpha : float         mixing weight α
+    probs : f64[M]        per-matching activation probabilities
+    flags : uint8[T, M]   per-iteration activation draws
+
+The flag stream is sampled **once, on the host, with an explicit seed** — in
+the reference each MPI rank redraws it and correctness silently depends on
+identical global numpy seeding (SURVEY.md §5.2); here there is a single SPMD
+program, so the hazard class is gone by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from ..topology import (
+    DecomposedGraph,
+    matching_laplacians,
+    matchings_to_perms,
+    mixing_matrix,
+    perms_to_neighbors,
+)
+from .solvers import contraction_rho
+
+__all__ = ["Schedule", "sample_flags"]
+
+
+def sample_flags(probs: np.ndarray, iterations: int, seed: int) -> np.ndarray:
+    """i.i.d. Bernoulli(probs[j]) activation flags, ``uint8[iterations, M]``.
+
+    Parity with ``MatchaProcessor.set_flags`` (graph_manager.py:298-309),
+    including the NaN/negative clamp to probability 0.
+    """
+    p = np.asarray(probs, dtype=np.float64).copy()
+    p[~np.isfinite(p)] = 0.0
+    p = np.clip(p, 0.0, 1.0)
+    rng = np.random.default_rng(seed)
+    return (rng.random((iterations, p.shape[0])) < p[None, :]).astype(np.uint8)
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """Static gossip schedule for ``iterations`` steps over ``N`` workers."""
+
+    perms: np.ndarray  # int32[M, N]
+    alpha: float
+    probs: np.ndarray  # f64[M]
+    flags: np.ndarray  # uint8[T, M]
+    decomposed: DecomposedGraph = dataclasses.field(repr=False)
+    name: str = "schedule"
+
+    def __post_init__(self):
+        M, N = self.perms.shape
+        assert self.flags.ndim == 2 and self.flags.shape[1] == M, (
+            f"flags {self.flags.shape} vs {M} matchings"
+        )
+        assert self.probs.shape == (M,)
+
+    @property
+    def num_matchings(self) -> int:
+        return int(self.perms.shape[0])
+
+    @property
+    def num_workers(self) -> int:
+        return int(self.perms.shape[1])
+
+    @property
+    def iterations(self) -> int:
+        return int(self.flags.shape[0])
+
+    # ----- reference-compatibility views ------------------------------------
+
+    @property
+    def neighbor_weight(self) -> float:
+        """Reference name for α (communicator.py:84)."""
+        return self.alpha
+
+    @property
+    def neighbors_info(self) -> np.ndarray:
+        """Partner-or−1 table (graph_manager.py:157-180 convention)."""
+        return perms_to_neighbors(self.perms)
+
+    @property
+    def active_flags(self) -> List[List[int]]:
+        """Per-iteration flag lists (graph_manager.py:309 convention)."""
+        return [list(map(int, row)) for row in self.flags]
+
+    # ----- analysis ---------------------------------------------------------
+
+    def laplacians(self) -> np.ndarray:
+        cached = self.__dict__.get("_laplacians")
+        if cached is None:
+            cached = matching_laplacians(self.decomposed, self.num_workers)
+            object.__setattr__(self, "_laplacians", cached)  # frozen-safe memo
+        return cached
+
+    def mixing_matrix_at(self, t: int) -> np.ndarray:
+        """Dense ``W_t = I − α·Σ_active L_j`` oracle for step ``t``."""
+        return mixing_matrix(self.laplacians(), self.flags[t], self.alpha)
+
+    def expected_rho(self) -> float:
+        """Expected per-step consensus contraction bound (ρ < 1 ⇒ converges)."""
+        return contraction_rho(self.laplacians(), self.probs, self.alpha)
+
+    def expected_comm_fraction(self) -> float:
+        """E[#active matchings] / M — the realized communication budget."""
+        return float(np.mean(self.probs))
+
+    def slice(self, start: int, stop: int) -> "Schedule":
+        """A view of steps [start, stop) — used for epoch-chunked scans."""
+        return dataclasses.replace(self, flags=self.flags[start:stop])

@@ -1,11 +1,16 @@
 """Test harness: run JAX on 8 virtual CPU devices so shard_map/ppermute
-semantics are exercised without a TPU pod (SURVEY.md §4)."""
+semantics are exercised without a TPU pod (SURVEY.md §4).
+
+The container's sitecustomize force-registers the axon TPU backend at
+interpreter startup (before pytest imports this file), so setting
+JAX_PLATFORMS here is too late — we override through jax.config instead,
+which takes effect because backends initialize lazily."""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,184 @@
+"""Communicator golden tests against independent numpy simulations of the
+reference per-rank semantics (communicator.py:79-268)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+from matcha_tpu.communicator import (
+    make_centralized,
+    make_choco,
+    make_decen,
+    make_none,
+    select_communicator,
+)
+from matcha_tpu.ops import top_k_ratio_size
+from matcha_tpu.schedule import fixed_schedule, matcha_schedule
+from matcha_tpu.parallel import worker_mesh, shard_workers
+
+
+def random_state(n, d, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+# ------------------------------------------------------------------- decen
+
+def numpy_decen_reference(x0, sched, T):
+    """Per-rank mirror of decenCommunicator.averaging (communicator.py:92-122)."""
+    x = x0.astype(np.float64).copy()
+    nbrs = sched.neighbors_info
+    alpha = sched.alpha
+    for t in range(T):
+        flags = sched.flags[t]
+        if flags.sum() == 0:
+            continue
+        new = np.zeros_like(x)
+        for i in range(x.shape[0]):
+            deg = 0
+            for j, f in enumerate(flags):
+                if f and nbrs[j][i] != -1:
+                    deg += 1
+                    new[i] += alpha * x[nbrs[j][i]]
+            new[i] += (1 - deg * alpha) * x[i]
+        x = new
+    return x
+
+
+@pytest.mark.parametrize("gid", [0, 5])
+def test_decen_matches_reference_simulation(gid):
+    size = tp.graph_size(gid)
+    sched = matcha_schedule(tp.select_graph(gid), size, iterations=30, budget=0.5, seed=3)
+    comm = make_decen(sched)
+    x0 = random_state(size, 25, seed=gid)
+    got, _ = jax.jit(comm.run)(jnp.asarray(x0), sched.flags)
+    want = numpy_decen_reference(x0, sched, 30)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+def test_decen_skip_iterations_are_identity():
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=4, mode="bernoulli", budget=0.0)
+    assert sched.flags.sum() == 0
+    comm = make_decen(sched)
+    x0 = jnp.asarray(random_state(8, 7))
+    got, _ = comm.run(x0, sched.flags)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x0))
+
+
+def test_decen_shard_map_backend_parity():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = worker_mesh(8)
+    sched = matcha_schedule(tp.select_graph(2), 16, iterations=12, budget=0.5, seed=1)
+    x0 = random_state(16, 19, seed=4)
+    a, _ = make_decen(sched).run(jnp.asarray(x0), sched.flags)
+    comm = make_decen(sched, mesh=mesh, backend="shard_map")
+    xs = shard_workers(jnp.asarray(x0), mesh)
+    b, _ = jax.jit(comm.run)(xs, sched.flags)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------------- choco
+
+def numpy_choco_reference(x0, sched, ratio, gamma, T):
+    """Per-rank mirror of ChocoCommunicator (communicator.py:161-268)."""
+    x = x0.astype(np.float64).copy()
+    N, D = x.shape
+    x_hat = np.zeros_like(x)
+    s = np.zeros_like(x)
+    k = top_k_ratio_size(D, ratio)
+    nbrs = sched.neighbors_info
+    alpha = sched.alpha
+    for t in range(T):
+        flags = sched.flags[t]
+        if flags.sum() == 0:
+            continue  # reference early-return: nothing mutates
+        q = x - x_hat
+        idxs = [np.argsort(-np.abs(q[i]), kind="stable")[:k] for i in range(N)]
+        vals = [q[i][idxs[i]] for i in range(N)]
+        for i in range(N):
+            deg = 0
+            for j, f in enumerate(flags):
+                if f and nbrs[j][i] != -1:
+                    deg += 1
+                    p = nbrs[j][i]
+                    np.add.at(s[i], idxs[p], alpha * vals[p])
+            np.add.at(s[i], idxs[i], (1 - deg * alpha) * vals[i])
+            np.add.at(x_hat[i], idxs[i], vals[i])
+            x[i] += gamma * (s[i] - x_hat[i])
+    return x
+
+
+@pytest.mark.parametrize("ratio", [0.0, 0.5, 0.9])
+def test_choco_matches_reference_simulation(ratio):
+    size = 8
+    sched = matcha_schedule(tp.select_graph(0), size, iterations=15, budget=0.5, seed=7)
+    comm = make_choco(sched, ratio=ratio, consensus_lr=0.3)
+    x0 = random_state(size, 21, seed=5)
+    got, carry = jax.jit(comm.run)(jnp.asarray(x0), sched.flags)
+    want = numpy_choco_reference(x0, sched, ratio, 0.3, 15)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=3e-4, atol=3e-5)
+    assert set(carry) == {"x_hat", "s"}
+
+
+def test_choco_keep_all_gamma1_equals_decen():
+    """CHOCO with no compression and consensus_lr=1 is exactly D-PSGD —
+    *provided the mixing matrix is constant across steps* (with varying W_t
+    the telescoped s accumulator picks up (W_t−W_{t'}) cross terms; the
+    SURVEY.md §4 equivalence needs both γ=1 and a fixed schedule)."""
+    size = 8
+    sched = fixed_schedule(tp.select_graph(5), size, iterations=20)
+    x0 = random_state(size, 15, seed=9)
+    a, _ = make_decen(sched).run(jnp.asarray(x0), sched.flags)
+    b, _ = make_choco(sched, ratio=0.0, consensus_lr=1.0).run(jnp.asarray(x0), sched.flags)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_choco_skip_iterations_freeze_all_state():
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=3, mode="bernoulli", budget=0.0)
+    comm = make_choco(sched, ratio=0.5)
+    x0 = jnp.asarray(random_state(8, 9))
+    carry0 = comm.init(x0)
+    got, carry = comm.run(x0, sched.flags, carry0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x0))
+    np.testing.assert_array_equal(np.asarray(carry["x_hat"]), 0)
+    np.testing.assert_array_equal(np.asarray(carry["s"]), 0)
+
+
+def test_choco_contracts_disagreement():
+    from matcha_tpu.parallel import worker_disagreement
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=400)
+    comm = make_choco(sched, ratio=0.7, consensus_lr=0.3)
+    x0 = jnp.asarray(random_state(8, 30, seed=1))
+    xT, _ = jax.jit(comm.run)(x0, sched.flags)
+    assert float(worker_disagreement(xT)) < 0.05 * float(worker_disagreement(x0))
+
+
+# ------------------------------------------------- centralized / none / registry
+
+def test_centralized_is_row_mean():
+    comm = make_centralized()
+    x0 = random_state(8, 12)
+    got, _ = comm.run(jnp.asarray(x0), np.ones((1, 1)))
+    np.testing.assert_allclose(
+        np.asarray(got), np.tile(x0.mean(0, keepdims=True), (8, 1)), rtol=1e-5
+    )
+
+
+def test_none_is_identity():
+    comm = make_none()
+    x0 = jnp.asarray(random_state(8, 6))
+    got, _ = comm.run(x0, np.ones((5, 2)))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(x0))
+
+
+def test_registry():
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=2)
+    assert select_communicator("decen", sched).name.startswith("decen")
+    assert select_communicator("choco", sched).name.startswith("choco")
+    assert select_communicator("centralized").name == "centralized"
+    assert select_communicator("none").name == "none"
+    with pytest.raises(KeyError):
+        select_communicator("quantum")

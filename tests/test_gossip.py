@@ -1,0 +1,178 @@
+"""Golden tests: every gossip backend must equal the dense ``W_t @ X`` oracle
+(SURVEY.md §4 'Golden test')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+from matcha_tpu.parallel import (
+    allreduce_mean,
+    build_folded_plan,
+    gossip_mix,
+    shard_map_gossip_fn,
+    shard_workers,
+    worker_disagreement,
+    worker_mesh,
+)
+from matcha_tpu.schedule import fixed_schedule, matcha_schedule
+
+
+def dense_oracle(x, schedule, t):
+    W = schedule.mixing_matrix_at(t)
+    return W @ x
+
+
+def random_state(n, d, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+@pytest.mark.parametrize("gid", [0, 2, 4, 5])
+def test_gather_backend_matches_dense_oracle(gid):
+    size = tp.graph_size(gid)
+    sched = matcha_schedule(tp.select_graph(gid), size, iterations=20, budget=0.6, seed=4)
+    x = random_state(size, 37, seed=gid)
+    for t in [0, 3, 7, 19]:
+        weights = sched.alpha * jnp.asarray(sched.flags[t], jnp.float32)
+        got = np.asarray(gossip_mix(jnp.asarray(x), sched.perms, weights))
+        want = dense_oracle(x, sched, t)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_gather_backend_zero_flags_is_identity():
+    sched = fixed_schedule(tp.select_graph(0), 8, iterations=2)
+    x = jnp.asarray(random_state(8, 11))
+    out = gossip_mix(x, sched.perms, jnp.zeros(5))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_gather_backend_under_jit_and_scan():
+    """Whole flag stream consumed inside one compiled scan — no host round-trips."""
+    size = 8
+    sched = matcha_schedule(tp.select_graph(0), size, iterations=50, budget=0.5, seed=0)
+    x0 = random_state(size, 13, seed=1)
+    flags = jnp.asarray(sched.flags, jnp.float32)
+
+    @jax.jit
+    def run(x, flags):
+        def step(x, flags_t):
+            return gossip_mix(x, sched.perms, sched.alpha * flags_t), None
+
+        return jax.lax.scan(step, x, flags)[0]
+
+    got = np.asarray(run(jnp.asarray(x0), flags))
+    want = x0.copy()
+    for t in range(50):
+        want = dense_oracle(want, sched, t)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------------------------- folded plan
+
+def test_folded_plan_partitions_slots():
+    sched = matcha_schedule(tp.select_graph(2), 16, iterations=4, budget=0.7, seed=2)
+    plan = build_folded_plan(sched.perms, num_chips=8)
+    assert plan.num_chips == 8 and plan.rows_per_chip == 2
+    for j, parts in enumerate(plan.matchings):
+        total = sum(p.mask for p in parts)
+        np.testing.assert_array_equal(total, np.ones((8, 2), np.float32))
+
+
+@pytest.mark.parametrize("num_chips", [1, 2, 4, 8])
+def test_folded_plan_reconstructs_permutation(num_chips):
+    sched = matcha_schedule(tp.select_graph(4), 16, iterations=4, budget=0.5, seed=3)
+    L = 16 // num_chips
+    plan = build_folded_plan(sched.perms, num_chips)
+    x = random_state(16, 5)
+    for j, parts in enumerate(plan.matchings):
+        # emulate the gather each chip performs
+        recon = np.zeros_like(x)
+        blocks = x.reshape(num_chips, L, -1)
+        for part in parts:
+            src_blocks = np.roll(np.arange(num_chips), -part.offset)  # chip c reads chip c+d
+            for c in range(num_chips):
+                y = blocks[src_blocks[c]]
+                recon[c * L : (c + 1) * L] += part.mask[c][:, None] * y[part.src_local[c]]
+        np.testing.assert_array_equal(recon, x[sched.perms[j]])
+
+
+# ------------------------------------------------- shard_map backend (8 dev)
+
+def need_8_devices():
+    return pytest.mark.skipif(
+        jax.device_count() < 8, reason="needs 8 virtual devices (see conftest)"
+    )
+
+
+@need_8_devices()
+@pytest.mark.parametrize("gid,size", [(0, 8), (5, 8), (2, 16), (3, 16)])
+def test_shard_map_backend_matches_dense_oracle(gid, size):
+    mesh = worker_mesh(8)
+    sched = matcha_schedule(tp.select_graph(gid), size, iterations=10, budget=0.6, seed=5)
+    fn = jax.jit(shard_map_gossip_fn(sched.perms, mesh))
+    x = random_state(size, 29, seed=gid + 10)
+    xs = shard_workers(jnp.asarray(x), mesh)
+    for t in [0, 2, 9]:
+        weights = sched.alpha * jnp.asarray(sched.flags[t], jnp.float32)
+        got = np.asarray(fn(xs, weights))
+        want = dense_oracle(x, sched, t)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@need_8_devices()
+def test_shard_map_backend_folded_256_workers():
+    """256 virtual workers on 8 chips — 32 rows per chip."""
+    mesh = worker_mesh(8)
+    n = 256
+    edges = tp.make_graph("geometric", n, seed=0)
+    dec = tp.decompose(edges, n, seed=0)
+    sched = fixed_schedule(dec, n, iterations=3)
+    fn = jax.jit(shard_map_gossip_fn(sched.perms, mesh))
+    x = random_state(n, 17, seed=9)
+    xs = shard_workers(jnp.asarray(x), mesh)
+    weights = sched.alpha * jnp.asarray(sched.flags[0], jnp.float32)
+    got = np.asarray(fn(xs, weights))
+    want = dense_oracle(x, sched, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@need_8_devices()
+def test_gather_backend_agrees_with_shard_map_backend():
+    mesh = worker_mesh(8)
+    sched = matcha_schedule(tp.select_graph(1), 16, iterations=5, budget=0.4, seed=6)
+    x = random_state(16, 23, seed=3)
+    weights = sched.alpha * jnp.asarray(sched.flags[1], jnp.float32)
+    a = np.asarray(gossip_mix(jnp.asarray(x), sched.perms, weights))
+    fn = jax.jit(shard_map_gossip_fn(sched.perms, mesh))
+    b = np.asarray(fn(shard_workers(jnp.asarray(x), mesh), weights))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------- collectives
+
+def test_allreduce_mean_and_disagreement():
+    x = random_state(8, 10)
+    out = np.asarray(allreduce_mean(jnp.asarray(x)))
+    np.testing.assert_allclose(out, np.tile(x.mean(0, keepdims=True), (8, 1)), rtol=1e-6)
+    assert float(worker_disagreement(jnp.asarray(out))) < 1e-6
+    assert float(worker_disagreement(jnp.asarray(x))) > 0.5
+
+
+def test_gossip_contracts_disagreement():
+    """Consensus-only integration test (SURVEY.md §4): repeated gossip must
+    contract disagreement at (better than) the rho bound."""
+    sched = matcha_schedule(tp.select_graph(0), 8, iterations=300, budget=0.5, seed=8)
+    x = jnp.asarray(random_state(8, 40, seed=2))
+    d0 = float(worker_disagreement(x))
+
+    def step(x, flags_t):
+        return gossip_mix(x, sched.perms, sched.alpha * flags_t), None
+
+    xT = jax.lax.scan(step, x, jnp.asarray(sched.flags, jnp.float32))[0]
+    dT = float(worker_disagreement(xT))
+    assert dT < d0 * 1e-3, (d0, dT)
+    # and the mean is preserved (doubly stochastic mixing)
+    np.testing.assert_allclose(
+        np.asarray(x).mean(0), np.asarray(xT).mean(0), rtol=1e-4, atol=1e-5
+    )

@@ -1,0 +1,204 @@
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+from matcha_tpu.schedule import (
+    Schedule,
+    contraction_rho,
+    fixed_schedule,
+    matcha_schedule,
+    project_box_capped_sum,
+    sample_flags,
+    solve_activation_probabilities,
+    solve_mixing_weight,
+)
+
+
+# ---------------------------------------------------------------- projection
+
+def test_projection_inside_feasible_is_identity():
+    p = np.array([0.2, 0.5, 0.9])
+    assert np.allclose(project_box_capped_sum(p, cap=2.0), p)
+
+
+def test_projection_clips_box():
+    p = np.array([-0.5, 1.7, 0.3])
+    q = project_box_capped_sum(p, cap=10.0)
+    assert np.allclose(q, [0.0, 1.0, 0.3])
+
+
+def test_projection_matches_scipy_qp():
+    from scipy.optimize import minimize
+
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        p = rng.normal(size=6) * 2
+        cap = rng.uniform(0.5, 3.0)
+        q = project_box_capped_sum(p, cap)
+        assert (q >= -1e-9).all() and (q <= 1 + 1e-9).all()
+        assert q.sum() <= cap + 1e-6
+        res = minimize(
+            lambda x: 0.5 * np.sum((x - p) ** 2),
+            np.clip(p, 0, 1) * 0,
+            bounds=[(0, 1)] * 6,
+            constraints=[{"type": "ineq", "fun": lambda x: cap - x.sum()}],
+        )
+        assert np.allclose(q, res.x, atol=1e-4), (q, res.x)
+
+
+# ---------------------------------------------------------------- problem 1
+
+def test_probabilities_respect_constraints():
+    for gid in [0, 4, 5]:
+        size = tp.graph_size(gid)
+        dec = tp.select_graph(gid)
+        Ls = tp.matching_laplacians(dec, size)
+        for budget in [0.25, 0.5, 0.9]:
+            p = solve_activation_probabilities(Ls, budget, iters=800)
+            assert (p >= -1e-9).all() and (p <= 1 + 1e-9).all()
+            assert p.sum() <= len(dec) * budget + 1e-6
+
+
+def test_probabilities_full_budget_is_all_ones():
+    # with cap = M the box is the only constraint and lambda2 is monotone in p
+    dec = tp.select_graph(5)
+    Ls = tp.matching_laplacians(dec, 8)
+    p = solve_activation_probabilities(Ls, 1.0, iters=500)
+    assert np.allclose(p, 1.0, atol=1e-3)
+
+
+def test_probabilities_symmetric_ring():
+    # ring: two matchings play symmetric roles -> optimal p is symmetric,
+    # and the budget should be saturated (more communication = more connectivity)
+    dec = tp.select_graph(5)
+    Ls = tp.matching_laplacians(dec, 8)
+    p = solve_activation_probabilities(Ls, 0.5, iters=2000)
+    assert abs(p[0] - p[1]) < 5e-3
+    assert p.sum() == pytest.approx(1.0, abs=1e-3)
+
+
+def test_probabilities_beat_uniform_on_er_graph():
+    # the solver should (weakly) beat naive uniform allocation on lambda1+lambda2
+    size, budget = 8, 0.5
+    dec = tp.select_graph(0)
+    Ls = tp.matching_laplacians(dec, size)
+    p = solve_activation_probabilities(Ls, budget, iters=3000)
+
+    def obj(q):
+        w = np.linalg.eigvalsh(np.tensordot(q, Ls, axes=1))
+        return w[0] + w[1]
+
+    uniform = np.full(len(dec), budget)
+    assert obj(p) >= obj(uniform) - 1e-6
+
+
+# ---------------------------------------------------------------- problem 2
+
+def test_alpha_matches_grid_search():
+    dec = tp.select_graph(0)
+    Ls = tp.matching_laplacians(dec, 8)
+    p = solve_activation_probabilities(Ls, 0.5, iters=1500)
+    alpha, rho = solve_mixing_weight(Ls, p)
+    grid = np.linspace(0, 2.0 / np.linalg.eigvalsh(np.tensordot(p, Ls, 1))[-1], 4001)
+    rhos = [contraction_rho(Ls, p, a) for a in grid]
+    assert rho <= min(rhos) + 1e-6
+    assert 0 < alpha < grid[-1]
+    assert rho < 1.0  # contraction must happen on a connected expected graph
+
+
+def test_alpha_zero_budget_degenerate():
+    dec = tp.select_graph(5)
+    Ls = tp.matching_laplacians(dec, 8)
+    alpha, rho = solve_mixing_weight(Ls, np.zeros(2))
+    assert alpha == 0.0 and rho == 1.0
+
+
+# ---------------------------------------------------------------- flags
+
+def test_sample_flags_statistics_and_determinism():
+    probs = np.array([0.9, 0.1, 0.5, np.nan, -0.3])
+    f1 = sample_flags(probs, 20000, seed=7)
+    f2 = sample_flags(probs, 20000, seed=7)
+    assert np.array_equal(f1, f2)
+    assert f1.dtype == np.uint8 and f1.shape == (20000, 5)
+    means = f1.mean(axis=0)
+    assert abs(means[0] - 0.9) < 0.02
+    assert abs(means[1] - 0.1) < 0.02
+    assert abs(means[2] - 0.5) < 0.02
+    assert means[3] == 0.0 and means[4] == 0.0  # NaN/negative clamped to 0
+    f3 = sample_flags(probs, 20000, seed=8)
+    assert not np.array_equal(f1, f3)
+
+
+# ---------------------------------------------------------------- schedules
+
+def test_fixed_schedule_all_mode():
+    dec = tp.select_graph(0)
+    s = fixed_schedule(dec, 8, iterations=10)
+    assert s.flags.shape == (10, 5)
+    assert s.flags.all()
+    W = s.mixing_matrix_at(0)
+    assert np.allclose(W.sum(0), 1) and np.allclose(W.sum(1), 1)
+    # closed-form alpha parity (graph_manager.py:196-206)
+    L = tp.base_laplacian(dec, 8)
+    w = np.linalg.eigvalsh(L)
+    assert s.alpha == pytest.approx(2.0 / (w[1] + w[-1]))
+
+
+def test_fixed_schedule_alternating_reference_parity():
+    dec = tp.select_graph(5)
+    s = fixed_schedule(dec, 8, iterations=6, mode="alternating")
+    assert s.active_flags[0] == [0, 1]
+    assert s.active_flags[1] == [1, 0]
+    assert s.active_flags[2] == [0, 1]
+    with pytest.raises(ValueError):
+        fixed_schedule(tp.select_graph(0), 8, 4, mode="alternating")
+
+
+def test_fixed_schedule_bernoulli_mode():
+    dec = tp.select_graph(0)
+    s = fixed_schedule(dec, 8, iterations=5000, budget=0.3, mode="bernoulli", seed=3)
+    assert abs(s.flags.mean() - 0.3) < 0.02
+
+
+def test_matcha_schedule_end_to_end():
+    dec = tp.select_graph(0)
+    s = matcha_schedule(dec, 8, iterations=200, budget=0.5, seed=1)
+    assert isinstance(s, Schedule)
+    assert s.num_matchings == 5 and s.num_workers == 8 and s.iterations == 200
+    assert s.expected_rho() < 1.0
+    assert 0 < s.alpha < 1.0
+    # budget respected in expectation
+    assert s.probs.sum() <= 5 * 0.5 + 1e-6
+    # reference-compat views
+    assert len(s.active_flags) == 200
+    assert s.neighbors_info.shape == (5, 8)
+    assert s.neighbor_weight == s.alpha
+
+
+def test_matcha_schedule_redecompose_deterministic():
+    dec = tp.select_graph(0)
+    s1 = matcha_schedule(dec, 8, 50, budget=0.5, seed=9, redecompose=True)
+    s2 = matcha_schedule(dec, 8, 50, budget=0.5, seed=9, redecompose=True)
+    assert np.array_equal(s1.perms, s2.perms)
+    assert np.array_equal(s1.flags, s2.flags)
+    assert s1.alpha == s2.alpha
+
+
+def test_matcha_warns_if_no_contraction():
+    # a disconnected base graph can never contract to global consensus
+    dec = [[(0, 1), (2, 3)]]  # one matching, union disconnected on 4 nodes
+    with pytest.warns(UserWarning, match="rho"):
+        matcha_schedule(dec, 4, 10, budget=0.5, solver_iters=100)
+    # and the underlying bound really is >= 1 for any alpha
+    Ls = tp.matching_laplacians(dec, 4)
+    for a in [0.1, 0.3, 0.5, 1.0]:
+        assert contraction_rho(Ls, np.array([0.5]), a) >= 1.0 - 1e-6
+
+
+def test_schedule_slice():
+    dec = tp.select_graph(5)
+    s = fixed_schedule(dec, 8, iterations=10)
+    sl = s.slice(2, 6)
+    assert sl.iterations == 4
+    assert np.array_equal(sl.perms, s.perms)
