@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Headline benchmark: gossip-steps/sec at 256 virtual workers.
+
+Measures the MATCHA hot path of BASELINE.json's north star — 256 virtual
+workers, ResNet-20-sized flat parameter state, MATCHA schedule at budget 0.5 —
+and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "gossip_steps_per_sec", "vs_baseline": N}
+
+``vs_baseline`` is value / 5000 (the ≥5k steps/sec north-star target; the
+reference publishes no numbers of its own — BASELINE.md).
+
+Flags:
+  --smoke        tiny sizes for a CPU sanity run
+  --backend B    dense|gather|shard_map|all   (default dense — the MXU path)
+  --dtype D      bf16|f32                     (default bf16)
+  --steps N      scan length per timing rep
+  --workers N    virtual workers (default 256)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build(args):
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu import topology as tp
+    from matcha_tpu.models import ResNet
+    from matcha_tpu.schedule import matcha_schedule
+
+    n = args.workers
+    if args.smoke:
+        n, dim, steps = 16, 4096, 50
+    else:
+        # flat dimension = actual ResNet-20/CIFAR-10 parameter count
+        model = ResNet(depth=20, num_classes=10)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        dim = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(variables["params"]))
+        steps = args.steps
+
+    edges = tp.make_graph("geometric", n, seed=1)
+    dec = tp.decompose(edges, n, seed=1)
+    sched = matcha_schedule(dec, n, iterations=steps, budget=0.5, seed=0)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(n, dim)).astype(np.float32))
+    return sched, x, steps, dim
+
+
+def time_backend(backend, sched, x, steps, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu.communicator import make_decen
+
+    compute_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    mesh = None
+    if backend == "shard_map":
+        from matcha_tpu.parallel import worker_mesh
+
+        mesh = worker_mesh()  # all local devices; workers fold onto them
+    comm = make_decen(sched, backend=backend, mesh=mesh, compute_dtype=compute_dtype)
+    flags = jnp.asarray(sched.flags, jnp.float32)
+    if backend == "dense":
+        x = x.astype(compute_dtype)  # state rides in the wire dtype end-to-end
+    run = jax.jit(lambda x: comm.run(x, flags)[0])
+    out = run(x)
+    out.block_until_ready()  # compile + warmup
+    reps, best = 3, float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(x)
+        out.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return steps / best
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--backend", default="dense")
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--workers", type=int, default=256)
+    args = p.parse_args()
+
+    sched, x, steps, dim = build(args)
+
+    backends = ["dense", "gather"] if args.backend == "all" else [args.backend]
+    results = {b: time_backend(b, sched, x, steps, args.dtype) for b in backends}
+    for b, v in results.items():
+        if len(backends) > 1:
+            print(f"# {b}: {v:.1f} steps/s", file=sys.stderr)
+
+    value = max(results.values())
+    print(json.dumps({
+        "metric": f"gossip-steps/sec @ {x.shape[0]} virtual workers, "
+                  f"D={dim} (ResNet-20), MATCHA budget 0.5, {args.dtype}",
+        "value": round(value, 1),
+        "unit": "gossip_steps_per_sec",
+        "vs_baseline": round(value / 5000.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,4 +19,6 @@ __version__ = "0.1.0"
 
 from . import topology  # noqa: F401
 
+# heavier layers import on demand:
+#   matcha_tpu.schedule, .parallel, .ops, .communicator, .models, .data, .train
 __all__ = ["topology"]

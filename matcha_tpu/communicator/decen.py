@@ -19,7 +19,9 @@ from typing import Callable
 import jax
 import numpy as np
 
-from ..parallel import gossip_mix, shard_map_gossip_fn
+import jax.numpy as jnp
+
+from ..parallel import dense_gossip_fn, gossip_mix, shard_map_gossip_fn
 from ..schedule import Schedule
 from .base import Communicator
 
@@ -30,21 +32,29 @@ def make_decen(
     schedule: Schedule,
     mesh=None,
     backend: str = "auto",
+    compute_dtype=jnp.float32,
 ) -> Communicator:
     """Build the gossip communicator for a schedule.
 
-    ``backend``: ``"gather"`` (jit + sharding; any N), ``"shard_map"``
-    (explicit ppermute plan over ``mesh``), or ``"auto"`` — shard_map when a
-    multi-device mesh is provided, else gather.
+    ``backend``:
+      * ``"dense"``     — one MXU matmul per step (W_t @ x); the single-chip /
+                          feature-sharded fast path and the bench configuration.
+      * ``"gather"``    — per-matching static gathers (any N under jit).
+      * ``"shard_map"`` — explicit ppermute plan over ``mesh`` (worker-sharded,
+                          the physical-decentralization path where ICI carries
+                          only gossip edges).
+      * ``"auto"``      — shard_map on a multi-device mesh, else dense.
     """
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
 
     if backend == "auto":
-        backend = "shard_map" if (mesh is not None and mesh.size > 1) else "gather"
+        backend = "shard_map" if (mesh is not None and mesh.size > 1) else "dense"
 
     if backend == "gather":
         mix: Callable = lambda x, w: gossip_mix(x, perms, w)
+    elif backend == "dense":
+        mix = dense_gossip_fn(schedule.laplacians(), compute_dtype=compute_dtype)
     elif backend == "shard_map":
         if mesh is None:
             raise ValueError("shard_map backend needs a mesh")

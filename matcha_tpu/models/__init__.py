@@ -1,0 +1,25 @@
+"""Flax model zoo: CIFAR ResNets (incl. ResNet-20), VGG-BN, WideResNet, MLP."""
+
+from .mlp import MLP
+from .registry import (
+    available_models,
+    dataset_input_shape,
+    dataset_num_classes,
+    select_model,
+)
+from .resnet import ResNet, resnet_config
+from .vgg import VGG, vgg_config
+from .wrn import WideResNet
+
+__all__ = [
+    "MLP",
+    "ResNet",
+    "VGG",
+    "WideResNet",
+    "available_models",
+    "dataset_input_shape",
+    "dataset_num_classes",
+    "resnet_config",
+    "select_model",
+    "vgg_config",
+]

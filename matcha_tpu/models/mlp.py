@@ -1,0 +1,28 @@
+"""EMNIST MLP in Flax.
+
+Parity with /root/reference/models/MLP.py:5-29: 784-500-500-classes with ReLU
+(the reference's manual weight/grad helpers at MLP.py:31-56 are dead code and
+intentionally not reproduced).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["MLP"]
+
+
+class MLP(nn.Module):
+    num_classes: int = 47
+    hidden: int = 500
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype, name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc3")(x)

@@ -1,0 +1,114 @@
+"""CIFAR-style ResNets in Flax (NHWC, TPU-native).
+
+Capability parity with the reference model zoo
+(/root/reference/models/resnet.py:89-122): 3 stages of 16/32/64 planes,
+3×3 stem, 8×8 average pool, single linear head; named depths
+{18, 34, 50, 101, 152} use the reference's (block, num_blocks) table
+(resnet.py:21-32, first three entries of each list — the fourth is unused in
+the 3-stage layout).  Additionally supports the classic CIFAR family
+{20, 32, 44, 56, 110} with (depth−2)/6 basic blocks per stage — the
+"ResNet-20" named by BASELINE.json that the reference zoo cannot express.
+
+TPU notes: convolutions carry bias like the reference (bias=True); BatchNorm
+statistics are **per virtual worker** — the module is vmapped over the worker
+axis by the trainer, so no cross-worker stat syncing can occur (SURVEY.md §7
+"BatchNorm under decentralized DP").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "resnet_config"]
+
+
+def resnet_config(depth: int) -> Tuple[str, Sequence[int]]:
+    """(block_kind, blocks_per_stage) for a named depth."""
+    reference = {
+        18: ("basic", (2, 2, 2)),
+        34: ("basic", (3, 4, 6)),
+        50: ("bottleneck", (3, 4, 6)),
+        101: ("bottleneck", (3, 4, 23)),
+        152: ("bottleneck", (3, 8, 36)),
+    }
+    if depth in reference:
+        return reference[depth]
+    if depth >= 20 and (depth - 2) % 6 == 0:  # classic CIFAR ResNet-6n+2
+        n = (depth - 2) // 6
+        return "basic", (n, n, n)
+    raise ValueError(
+        f"unsupported ResNet depth {depth}: need one of {sorted(reference)} or 6n+2"
+    )
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = lambda f, s, n: nn.Conv(
+            f, (3, 3), strides=(s, s), padding=1, use_bias=True, dtype=self.dtype, name=n
+        )
+        bn = lambda n: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=self.dtype, name=n)
+        out = nn.relu(bn("bn1")(conv(self.planes, self.stride, "conv1")(x)))
+        out = bn("bn2")(conv(self.planes, 1, "conv2")(out))
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            x = nn.Conv(self.planes, (1, 1), strides=(self.stride, self.stride),
+                        use_bias=True, dtype=self.dtype, name="shortcut_conv")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, name="shortcut_bn")(x)
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = lambda n: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=self.dtype, name=n)
+        out = nn.relu(bn("bn1")(nn.Conv(self.planes, (1, 1), use_bias=True,
+                                        dtype=self.dtype, name="conv1")(x)))
+        out = nn.relu(bn("bn2")(nn.Conv(self.planes, (3, 3),
+                                        strides=(self.stride, self.stride), padding=1,
+                                        use_bias=True, dtype=self.dtype, name="conv2")(out)))
+        out = bn("bn3")(nn.Conv(self.planes * self.expansion, (1, 1), use_bias=True,
+                                dtype=self.dtype, name="conv3")(out))
+        want = self.planes * self.expansion
+        if self.stride != 1 or x.shape[-1] != want:
+            x = nn.Conv(want, (1, 1), strides=(self.stride, self.stride), use_bias=True,
+                        dtype=self.dtype, name="shortcut_conv")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, name="shortcut_bn")(x)
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    """3-stage CIFAR ResNet; input NHWC (e.g. [B, 32, 32, 3]), output logits."""
+
+    depth: int = 20
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        kind, blocks = resnet_config(self.depth)
+        block: Callable = BasicBlock if kind == "basic" else Bottleneck
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=True, dtype=self.dtype, name="stem")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype, name="stem_bn")(x))
+        for stage, (planes, stride) in enumerate(zip((16, 32, 64), (1, 2, 2))):
+            for b in range(blocks[stage]):
+                x = block(planes=planes, stride=stride if b == 0 else 1,
+                          dtype=self.dtype, name=f"stage{stage}_block{b}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average over the 8x8 map
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -1,0 +1,52 @@
+"""VGG with BatchNorm in Flax (NHWC).
+
+Parity with /root/reference/models/vggnet.py:12-76: conv3x3+BN+ReLU stacks
+with 2×2 max pools, single 512→classes linear head (CIFAR layout — the final
+feature map is 1×1 after five pools of a 32×32 input).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["VGG", "vgg_config"]
+
+_CFG = {
+    11: (64, "mp", 128, "mp", 256, 256, "mp", 512, 512, "mp", 512, 512, "mp"),
+    13: (64, 64, "mp", 128, 128, "mp", 256, 256, "mp", 512, 512, "mp", 512, 512, "mp"),
+    16: (64, 64, "mp", 128, 128, "mp", 256, 256, 256, "mp",
+         512, 512, 512, "mp", 512, 512, 512, "mp"),
+    19: (64, 64, "mp", 128, 128, "mp", 256, 256, 256, 256, "mp",
+         512, 512, 512, 512, "mp", 512, 512, 512, 512, "mp"),
+}
+
+
+def vgg_config(depth: int) -> Sequence[Union[int, str]]:
+    if depth not in _CFG:
+        raise ValueError(f"VGG depth must be one of {sorted(_CFG)}, got {depth}")
+    return _CFG[depth]
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        li = 0
+        for item in vgg_config(self.depth):
+            if item == "mp":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(item), (3, 3), padding=1, use_bias=True,
+                            dtype=self.dtype, name=f"conv{li}")(x)
+                x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype, name=f"bn{li}")(x)
+                x = nn.relu(x)
+                li += 1
+        x = x.reshape((x.shape[0], -1))  # [B, 512] for 32x32 inputs
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

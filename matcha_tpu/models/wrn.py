@@ -1,0 +1,65 @@
+"""WideResNet in Flax (NHWC).
+
+Parity with /root/reference/models/wrn.py:22-83: pre-activation wide basic
+blocks (BN→ReLU→conv→dropout→BN→ReLU→conv, un-normalized 1×1 conv shortcut),
+stages [16, 16k, 32k, 64k], depth = 6n+4, final BN with fast-moving stats
+(torch momentum 0.9 ⇒ flax momentum 0.1), 8×8 average pool.  The reference
+driver uses dropout 0 (util.py:269); dropout is kept as a real option.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["WideResNet"]
+
+
+class WideBasic(nn.Module):
+    planes: int
+    stride: int = 1
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = lambda n: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                    dtype=self.dtype, name=n)
+        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=True,
+                      dtype=self.dtype, name="conv1")(nn.relu(bn("bn1")(x)))
+        if self.dropout_rate > 0:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        out = nn.Conv(self.planes, (3, 3), strides=(self.stride, self.stride), padding=1,
+                      use_bias=True, dtype=self.dtype, name="conv2")(nn.relu(bn("bn2")(out)))
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            x = nn.Conv(self.planes, (1, 1), strides=(self.stride, self.stride),
+                        use_bias=True, dtype=self.dtype, name="shortcut_conv")(x)
+        return out + x
+
+
+class WideResNet(nn.Module):
+    depth: int = 28
+    widen_factor: int = 10
+    dropout_rate: float = 0.0
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if (self.depth - 4) % 6 != 0:
+            raise ValueError("WideResNet depth must be 6n+4")
+        n = (self.depth - 4) // 6
+        k = self.widen_factor
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=True, dtype=self.dtype, name="stem")(x)
+        for stage, (planes, stride) in enumerate(zip((16 * k, 32 * k, 64 * k), (1, 2, 2))):
+            for b in range(n):
+                x = WideBasic(planes=planes, stride=stride if b == 0 else 1,
+                              dropout_rate=self.dropout_rate, dtype=self.dtype,
+                              name=f"stage{stage}_block{b}")(x, train)
+        # torch momentum=0.9 on the final BN (wrn.py:60) == flax momentum 0.1
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.1,
+                                 dtype=self.dtype, name="final_bn")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

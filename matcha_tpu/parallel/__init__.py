@@ -4,7 +4,9 @@ from .collectives import allreduce_mean, broadcast_worker0, worker_disagreement
 from .gossip import (
     FoldedPlan,
     build_folded_plan,
+    dense_gossip_fn,
     gossip_mix,
+    gossip_mix_dense,
     gossip_mix_folded,
     shard_map_gossip_fn,
 )
@@ -16,8 +18,10 @@ __all__ = [
     "allreduce_mean",
     "broadcast_worker0",
     "build_folded_plan",
+    "dense_gossip_fn",
     "fold_dims",
     "gossip_mix",
+    "gossip_mix_dense",
     "gossip_mix_folded",
     "replicated",
     "shard_map_gossip_fn",

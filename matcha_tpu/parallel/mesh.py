@@ -48,6 +48,8 @@ def fold_dims(num_workers: int, mesh: Mesh, axis: str = WORKER_AXIS) -> tuple[in
 def shard_workers(x, mesh: Mesh, axis: str = WORKER_AXIS):
     """Place ``[N, ...]`` arrays with the leading axis sharded over the mesh."""
     def put(a):
+        if getattr(a, "ndim", 0) == 0:  # scalars (step counters) replicate
+            return jax.device_put(a, NamedSharding(mesh, P()))
         spec = P(axis, *([None] * (a.ndim - 1)))
         return jax.device_put(a, NamedSharding(mesh, spec))
 

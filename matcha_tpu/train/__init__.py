@@ -1,0 +1,33 @@
+"""Training layer: config, LR schedules, fused train step, driver loop,
+recorder, checkpointing."""
+
+from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .config import TrainConfig
+from .loop import TrainResult, build_dataset, build_schedule, train
+from .lr import make_lr_schedule
+from .recorder import Recorder
+from .state import (
+    TrainState,
+    init_train_state,
+    make_eval_fn,
+    make_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "Recorder",
+    "TrainConfig",
+    "TrainResult",
+    "TrainState",
+    "build_dataset",
+    "build_schedule",
+    "init_train_state",
+    "latest_step",
+    "make_eval_fn",
+    "make_lr_schedule",
+    "make_optimizer",
+    "make_train_step",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "train",
+]

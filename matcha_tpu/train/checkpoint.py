@@ -1,0 +1,57 @@
+"""Checkpoint / resume via orbax.
+
+The reference has **no checkpointing at all** — ``--save`` only gates log
+folders, there is no ``torch.save`` anywhere (SURVEY.md §5.4).  This module
+persists the full ``TrainState``: parameters, per-worker BN stats, optimizer
+state, the communicator carry (CHOCO's ``x_hat``/``s``), and the schedule
+cursor ``step`` — the pieces a naive restart would silently lose.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step"]
+
+
+def _manager(directory: str) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def save_checkpoint(directory: str, state: TrainState, epoch: int) -> None:
+    mgr = _manager(directory)
+    mgr.save(epoch, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_checkpoint(directory: str, template: TrainState, epoch: Optional[int] = None):
+    """Restore into the structure of ``template`` (shapes/dtypes must match).
+    Returns ``(state, epoch)``."""
+    mgr = _manager(directory)
+    step = epoch if epoch is not None else mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
+    state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mgr.close()
+    return state, int(step)

@@ -1,0 +1,77 @@
+"""Training configuration.
+
+Replaces the reference's argparse namespace (/root/reference/train_mpi.py:205-231)
+with a typed dataclass.  Field names keep the reference's vocabulary where it
+exists (budget, graphid, compress, consensus_lr, ...) so reference users map
+configs 1:1; the ``default=True, action='store_true'`` anti-pattern flags
+(SURVEY.md §5.6) become honest booleans, and previously hard-coded values
+(Choco ratio, train_mpi.py:79) become real fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+__all__ = ["TrainConfig"]
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # experiment identity (reference: --name/--description, required)
+    name: str = "experiment"
+    description: str = "matcha_tpu run"
+
+    # model / data (reference: --model, --dataset, --bs)
+    model: str = "resnet20"
+    dataset: str = "synthetic"
+    batch_size: int = 32  # per worker
+    non_iid: bool = False
+    augment: bool = False
+    datasetRoot: Optional[str] = None  # .npz path for real datasets
+
+    # optimization (reference: --lr/--momentum/--epoch/--warmup/--nesterov + wd=5e-4)
+    lr: float = 0.8
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+    nesterov: bool = True
+    epochs: int = 200
+    warmup: bool = True
+    warmup_epochs: int = 5
+    base_lr: float = 0.1  # warmup start (train_mpi.py:183)
+    decay_epochs: Tuple[int, ...] = (100, 150)  # train_mpi.py:181,194
+    decay_factor: float = 0.1
+
+    # topology / schedule (reference: --graphid/--budget/--matcha)
+    num_workers: int = 8
+    graphid: Optional[int] = 0  # zoo id; None → use topology generator
+    topology: str = "ring"  # generator kind when graphid is None
+    matcha: bool = True
+    budget: float = 0.5
+    fixed_mode: str = "all"  # D-PSGD flag mode: all|bernoulli|alternating
+    seed: int = 9001  # reference --randomSeed default (train_mpi.py:230)
+
+    # communicator (reference: --compress/--consensus_lr; ratio was hard-coded)
+    communicator: str = "decen"  # decen|choco|centralized|none
+    compress_ratio: float = 0.9
+    consensus_lr: float = 0.1
+    gossip_backend: str = "auto"  # gather|shard_map|auto
+
+    # logging / checkpointing (reference: --save/--savePath; ckpt is new — §5.4)
+    save: bool = False
+    savePath: str = "runs"
+    checkpoint_every: int = 0  # epochs; 0 = disabled
+    resume: Optional[str] = None  # checkpoint dir to resume from
+    eval_every: int = 1
+
+    # execution
+    scan_epoch: bool = True  # lax.scan over an epoch's batches (one program)
+    devices: Optional[int] = None  # mesh size; None → all available
+
+    def __post_init__(self):
+        if self.communicator not in ("decen", "choco", "centralized", "none"):
+            raise ValueError(f"bad communicator '{self.communicator}'")
+        if self.num_workers < 2:
+            raise ValueError("need at least 2 virtual workers")
+        if not 0 <= self.budget <= 1:
+            raise ValueError("budget must be in [0, 1]")

@@ -1,0 +1,44 @@
+"""Learning-rate schedule: linear warmup + step decay.
+
+Parity with ``update_learning_rate`` (/root/reference/train_mpi.py:171-201):
+per-*iteration* linear warmup from ``base_lr`` to the target over
+``warmup_epochs`` (applied only when target > base, train_mpi.py:184-191),
+then ×``decay_factor`` at the decay epochs (100/150 in the reference code;
+its docstring claiming 30/60/80 is stale — SURVEY.md §2.4).  Expressed as a
+pure function of the global step so it compiles into the train step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["make_lr_schedule"]
+
+
+def make_lr_schedule(
+    target_lr: float,
+    batches_per_epoch: int,
+    base_lr: float = 0.1,
+    warmup: bool = True,
+    warmup_epochs: int = 5,
+    decay_epochs: Sequence[int] = (100, 150),
+    decay_factor: float = 0.1,
+) -> Callable:
+    """Return ``lr(step) -> f32`` usable as an optax schedule."""
+    bpe = int(batches_per_epoch)
+    warmup_steps = warmup_epochs * bpe if (warmup and target_lr > base_lr) else 0
+    incr = (target_lr - base_lr) / warmup_steps if warmup_steps else 0.0
+    boundaries = jnp.asarray([e * bpe for e in decay_epochs], jnp.int32)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.int32)
+        warm = base_lr + incr * jnp.minimum(step, warmup_steps)
+        lr = jnp.where(step < warmup_steps, warm, target_lr if warmup_steps else base_lr)
+        # no-warmup path: the reference keeps args.lr throughout (train_mpi.py:192)
+        lr = jnp.where(warmup_steps > 0, lr, target_lr)
+        ndecays = jnp.sum(step >= boundaries)
+        return lr * decay_factor**ndecays
+
+    return schedule

@@ -1,0 +1,78 @@
+"""Per-run metric recording with reference-compatible CSV output.
+
+Parity with ``Recorder`` (/root/reference/util.py:378-419): per-worker series
+written as ``dsgd-lr{lr}-budget{budget}-r{rank}-{kind}.log`` files plus an
+``ExpDescription`` dump of the config, under ``{savePath}/{name}_{model}/``.
+The seven reference series (recordtime, time, comptime, commtime, acc,
+losses, tacc) are kept and an eighth — ``disagreement``, the consensus error
+the reference never measures (SURVEY.md §5.5) — is added.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["Recorder"]
+
+SERIES = ("recordtime", "time", "comptime", "commtime", "acc", "losses", "tacc", "disagreement")
+
+
+class Recorder:
+    def __init__(self, config, num_workers: int):
+        self.config = config
+        self.num_workers = num_workers
+        self.data: Dict[str, List] = {k: [] for k in SERIES}
+        self.start = time.time()
+        self.folder = os.path.join(
+            config.savePath, f"{config.name}_{config.model}"
+        )
+
+    def add_epoch(
+        self,
+        epoch_time: float,
+        comp_time: float,
+        comm_time: float,
+        train_acc,  # [N] or scalar
+        train_loss,
+        test_acc,
+        disagreement: float,
+    ):
+        self.data["recordtime"].append(time.time() - self.start)
+        self.data["time"].append(epoch_time)
+        self.data["comptime"].append(comp_time)
+        self.data["commtime"].append(comm_time)
+        self.data["acc"].append(np.asarray(train_acc))
+        self.data["losses"].append(np.asarray(train_loss))
+        self.data["tacc"].append(np.asarray(test_acc))
+        self.data["disagreement"].append(disagreement)
+
+    @property
+    def epochs_recorded(self) -> int:
+        return len(self.data["time"])
+
+    def _series_for_worker(self, kind: str, rank: int) -> np.ndarray:
+        rows = []
+        for v in self.data[kind]:
+            arr = np.asarray(v)
+            rows.append(float(arr[rank]) if arr.ndim else float(arr))
+        return np.asarray(rows)
+
+    def save(self):
+        """Write per-worker CSV logs + ExpDescription (util.py:398-419)."""
+        os.makedirs(self.folder, exist_ok=True)
+        cfg = self.config
+        for rank in range(self.num_workers):
+            prefix = f"dsgd-lr{cfg.lr}-budget{cfg.budget}-r{rank}-"
+            for kind in SERIES:
+                path = os.path.join(self.folder, prefix + kind + ".log")
+                np.savetxt(path, self._series_for_worker(kind, rank), delimiter=",")
+        desc = os.path.join(self.folder, "ExpDescription")
+        with open(desc, "w") as f:
+            f.write(f"{cfg.name} {cfg.description}\n")
+            for field in dataclasses.fields(cfg):
+                f.write(f"{field.name}: {getattr(cfg, field.name)}\n")

@@ -1,0 +1,184 @@
+"""Train state and the fused (SGD + gossip) step.
+
+TPU-native re-design of the reference's inner loop
+(/root/reference/train_mpi.py:109-145): forward/backward/SGD run *per virtual
+worker* via ``vmap`` over the leading worker axis, then the communicator's
+consensus transform runs on the flattened parameter stack — all inside one
+jit-compiled function, so XLA fuses gossip permutes with the update math and
+the whole step executes without host round-trips.
+
+Reference-semantics notes:
+* BatchNorm running statistics are per-worker state and are **not** gossiped —
+  the reference averages only ``model.parameters()`` (communicator.py:21-22),
+  and buffers are not parameters (SURVEY.md §7 BN note).
+* The optimizer is torch-style SGD: weight decay added to the gradient before
+  the momentum buffer, Nesterov lookahead, per-iteration LR schedule
+  (train_mpi.py:87-92, 131).
+* Workers start from an AllReduce average of their independent inits
+  (train_mpi.py:97 ``sync_allreduce``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from ..communicator import Communicator
+from ..ops import WorkerFlattener
+from ..parallel import allreduce_mean, worker_disagreement
+from ..utils import cross_entropy_loss, top_k_accuracy
+
+__all__ = ["TrainState", "init_train_state", "make_train_step", "make_eval_fn", "make_optimizer"]
+
+
+class TrainState(struct.PyTreeNode):
+    params: Any  # pytree, leaves [N, ...]
+    batch_stats: Any  # pytree, leaves [N, ...] (possibly empty dict)
+    opt_state: Any
+    comm_carry: Any
+    step: jax.Array  # scalar int32 — also the schedule cursor (ckpt-critical)
+
+
+def make_optimizer(
+    lr_schedule: Callable,
+    momentum: float = 0.9,
+    weight_decay: float = 5e-4,
+    nesterov: bool = True,
+) -> optax.GradientTransformation:
+    """torch.optim.SGD(momentum, weight_decay, nesterov) equivalent
+    (train_mpi.py:87-92): wd folds into the gradient before the momentum trace."""
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(lr_schedule, momentum=momentum, nesterov=nesterov),
+    )
+
+
+def init_train_state(
+    model,
+    input_shape,
+    num_workers: int,
+    optimizer: optax.GradientTransformation,
+    communicator: Communicator,
+    seed: int = 0,
+    sync_init: bool = True,
+) -> tuple[TrainState, WorkerFlattener]:
+    """Per-worker independent inits (torch per-rank ``seed+rank``,
+    train_mpi.py:61) followed by the reference's initial AllReduce sync."""
+    dummy = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
+
+    def init_one(key):
+        variables = model.init(key, dummy, train=False)
+        return variables.get("params"), variables.get("batch_stats", {})
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), num_workers)
+    params, batch_stats = jax.vmap(init_one)(keys)
+
+    flattener = WorkerFlattener(params)
+    if sync_init:
+        flat = allreduce_mean(flattener.flatten(params))
+        params = flattener.unflatten(flat)
+
+    state = TrainState(
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=optimizer.init(params),
+        comm_carry=communicator.init(flattener.flatten(params)),
+        step=jnp.zeros((), jnp.int32),
+    )
+    return state, flattener
+
+
+def make_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    communicator: Communicator,
+    flattener: WorkerFlattener,
+    flags: np.ndarray,
+    dropout: bool = False,
+    lr_schedule: Optional[Callable] = None,
+):
+    """Build ``step(state, xb, yb[, rng]) -> (state, metrics)``.
+
+    ``xb: [N, B, ...]``, ``yb: int[N, B]``.  The activation-flag stream is a
+    trace-time constant array indexed by ``state.step`` — the whole schedule
+    compiles into the program (SURVEY.md §5.8) and survives checkpoint/resume
+    through the step cursor.
+    """
+    flags_arr = jnp.asarray(np.asarray(flags), jnp.float32)  # [T, M]
+
+    def loss_fn(params, batch_stats, x, y, rng):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        rngs = {"dropout": rng} if dropout else None
+        out = model.apply(variables, x, train=True,
+                          mutable=["batch_stats"] if batch_stats else [], rngs=rngs)
+        logits, mutated = out if isinstance(out, tuple) else (out, {})
+        loss = cross_entropy_loss(logits, y)
+        return loss, (mutated.get("batch_stats", {}), logits)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    @jax.jit
+    def step(state: TrainState, xb, yb, rng=None):
+        n = flattener.num_workers
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        rngs = jax.random.split(jax.random.fold_in(rng, state.step), n)
+
+        (loss, (new_stats, logits)), grads = jax.vmap(grad_fn)(
+            state.params, state.batch_stats, xb, yb, rngs
+        )
+
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        # consensus transform on the flattened parameter stack
+        flat = flattener.flatten(params)
+        t = jnp.minimum(state.step, flags_arr.shape[0] - 1)
+        flat, carry = communicator.step(flat, state.comm_carry, flags_arr[t])
+        params = flattener.unflatten(flat)
+
+        metrics = {
+            "loss": jnp.mean(loss),
+            "accuracy": jnp.mean(top_k_accuracy(logits, yb)),
+            "disagreement": worker_disagreement(flat),
+            "lr": lr_schedule(state.step) if lr_schedule else jnp.asarray(0.0),
+            "active_matchings": jnp.sum(flags_arr[t]),
+        }
+        return (
+            state.replace(
+                params=params,
+                batch_stats=new_stats,
+                opt_state=opt_state,
+                comm_carry=carry,
+                step=state.step + 1,
+            ),
+            metrics,
+        )
+
+    return step
+
+
+def make_eval_fn(model):
+    """Build ``evaluate(params, batch_stats, x, y) -> (loss[N], acc[N])`` —
+    every worker evaluates the full batch (matching the reference's
+    every-rank-evaluates pattern, train_mpi.py:152, but in one vmap)."""
+
+    @jax.jit
+    def evaluate(params, batch_stats, x, y):
+        def one(p, bs):
+            variables = {"params": p}
+            if bs:
+                variables["batch_stats"] = bs
+            logits = model.apply(variables, x, train=False)
+            return cross_entropy_loss(logits, y), top_k_accuracy(logits, y)
+
+        return jax.vmap(one)(params, batch_stats)
+
+    return evaluate

@@ -1,0 +1,5 @@
+"""Shared utilities: metrics, timing."""
+
+from .metrics import AverageMeter, cross_entropy_loss, top_k_accuracy
+
+__all__ = ["AverageMeter", "cross_entropy_loss", "top_k_accuracy"]

@@ -1,0 +1,42 @@
+"""Metrics: top-k accuracy and running averages.
+
+Parity with ``comp_accuracy`` and ``AverageMeter``
+(/root/reference/util.py:344-375), plus batched-over-workers variants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["top_k_accuracy", "cross_entropy_loss", "AverageMeter"]
+
+
+def top_k_accuracy(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
+    """Fraction of rows whose true label is within the top-k logits."""
+    topk = jax.lax.top_k(logits, k)[1]  # [..., k]
+    hit = jnp.any(topk == labels[..., None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32), axis=-1)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll, axis=-1)
+
+
+class AverageMeter:
+    """Running mean (util.py:360-375)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.sum = 0.0
+        self.count = 0.0
+        self.avg = 0.0
+
+    def update(self, value: float, n: float = 1.0):
+        self.sum += float(value) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1e-12)

@@ -68,6 +68,36 @@ def test_gather_backend_under_jit_and_scan():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+# ------------------------------------------------------------- dense backend
+
+@pytest.mark.parametrize("gid", [0, 4])
+def test_dense_backend_matches_dense_oracle(gid):
+    from matcha_tpu.parallel import dense_gossip_fn
+
+    size = tp.graph_size(gid)
+    sched = matcha_schedule(tp.select_graph(gid), size, iterations=10, budget=0.6, seed=7)
+    fn = jax.jit(dense_gossip_fn(sched.laplacians()))
+    x = random_state(size, 33, seed=gid)
+    for t in [0, 4, 9]:
+        weights = sched.alpha * jnp.asarray(sched.flags[t], jnp.float32)
+        got = np.asarray(fn(jnp.asarray(x), weights))
+        want = dense_oracle(x, sched, t)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_dense_backend_bf16_close_to_oracle():
+    from matcha_tpu.parallel import dense_gossip_fn
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=2)
+    fn = jax.jit(dense_gossip_fn(sched.laplacians(), compute_dtype=jnp.bfloat16))
+    x = random_state(8, 64, seed=3)
+    weights = sched.alpha * jnp.asarray(sched.flags[0], jnp.float32)
+    got = np.asarray(fn(jnp.asarray(x), weights))
+    want = dense_oracle(x, sched, 0)
+    # bf16 mantissa ~8 bits; f32 accumulation keeps the error small
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
 # ------------------------------------------------------------- folded plan
 
 def test_folded_plan_partitions_slots():

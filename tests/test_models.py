@@ -1,0 +1,129 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matcha_tpu.models import (
+    MLP,
+    ResNet,
+    VGG,
+    WideResNet,
+    dataset_num_classes,
+    resnet_config,
+    select_model,
+    vgg_config,
+)
+
+
+def init_and_apply(model, shape, train=True, seed=0):
+    x = jnp.ones((2,) + shape, jnp.float32)
+    variables = model.init(jax.random.PRNGKey(seed), x, train=False)
+    out, mutated = model.apply(
+        variables, x, train=train, mutable=["batch_stats"] if train else []
+    )
+    return variables, out
+
+
+def param_count(variables):
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(variables["params"]))
+
+
+def test_resnet_config_table():
+    assert resnet_config(18) == ("basic", (2, 2, 2))
+    assert resnet_config(50) == ("bottleneck", (3, 4, 6))
+    assert resnet_config(20) == ("basic", (3, 3, 3))
+    assert resnet_config(110) == ("basic", (18, 18, 18))
+    with pytest.raises(ValueError):
+        resnet_config(21)
+
+
+def test_resnet20_shape_and_params():
+    model = ResNet(depth=20, num_classes=10)
+    variables, out = init_and_apply(model, (32, 32, 3))
+    assert out.shape == (2, 10)
+    # classic ResNet-20 is ~0.27M params; conv bias (reference parity) adds a bit
+    assert 0.25e6 < param_count(variables) < 0.31e6
+
+
+def test_resnet18_reference_layout():
+    model = ResNet(depth=18, num_classes=100)
+    variables, out = init_and_apply(model, (32, 32, 3))
+    assert out.shape == (2, 100)
+
+
+def test_resnet50_bottleneck_runs():
+    model = ResNet(depth=50, num_classes=10)
+    _, out = init_and_apply(model, (32, 32, 3))
+    assert out.shape == (2, 10)
+
+
+def test_vgg16_shape_and_params():
+    assert len([c for c in vgg_config(16) if c != "mp"]) == 13
+    model = VGG(depth=16, num_classes=10)
+    variables, out = init_and_apply(model, (32, 32, 3))
+    assert out.shape == (2, 10)
+    # VGG-16-BN CIFAR: ~14.7M params
+    assert 14e6 < param_count(variables) < 16e6
+
+
+def test_wrn28_10_shape_and_params():
+    model = WideResNet(depth=28, widen_factor=10, num_classes=100)
+    variables, out = init_and_apply(model, (32, 32, 3))
+    assert out.shape == (2, 100)
+    # WRN-28-10: ~36.5M params
+    assert 35e6 < param_count(variables) < 38e6
+
+
+def test_mlp_shape_and_params():
+    model = MLP(num_classes=47)
+    variables, out = init_and_apply(model, (28, 28, 1))
+    assert out.shape == (2, 47)
+    want = 784 * 500 + 500 + 500 * 500 + 500 + 500 * 47 + 47
+    assert param_count(variables) == want
+
+
+def test_batch_stats_update_in_train_mode():
+    model = ResNet(depth=20, num_classes=10)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32, 32, 3)), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_eval_mode_is_deterministic_and_frozen():
+    model = VGG(depth=11, num_classes=10)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    a = model.apply(variables, x, train=False)
+    b = model.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_registry_reference_policy():
+    # util.py:258-264: 'res' -> depth 50 on cifar10, 18 on cifar100
+    assert select_model("res", "cifar10").depth == 50
+    assert select_model("res", "cifar100").depth == 18
+    assert select_model("res", "cifar100").num_classes == 100  # Q6 fixed
+    assert select_model("VGG", "cifar10").depth == 16
+    m = select_model("wrn", "cifar100")
+    assert (m.depth, m.widen_factor) == (28, 10)
+    assert select_model("mlp", "emnist").num_classes == 47
+    assert select_model("resnet20", "cifar10").depth == 20
+    assert select_model("vgg19", "cifar10").depth == 19
+    wrn = select_model("wrn-16-4", "cifar10")
+    assert (wrn.depth, wrn.widen_factor) == (16, 4)
+    with pytest.raises(KeyError):
+        select_model("transformer")
+    with pytest.raises(KeyError):
+        dataset_num_classes("mnist99")
+
+
+def test_jit_forward():
+    model = select_model("resnet20", "cifar10")
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+    out = fwd(variables, x)
+    assert out.shape == (2, 10)
