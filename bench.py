@@ -12,7 +12,9 @@ reference publishes no numbers of its own — BASELINE.md).
 
 Flags:
   --smoke        tiny sizes for a CPU sanity run
-  --backend B    dense|gather|shard_map|all   (default dense — the MXU path)
+  --backend B    fused|dense|gather|shard_map|all   (default fused — the
+                 Pallas VMEM-resident multi-step kernel; dense is the
+                 per-step MXU path)
   --dtype D      bf16|f32                     (default bf16)
   --steps N      scan length per timing rep
   --workers N    virtual workers (default 256)
@@ -67,16 +69,20 @@ def time_backend(backend, sched, x, steps, dtype):
         mesh = worker_mesh()  # all local devices; workers fold onto them
     comm = make_decen(sched, backend=backend, mesh=mesh, compute_dtype=compute_dtype)
     flags = jnp.asarray(sched.flags, jnp.float32)
-    if backend == "dense":
+    if backend in ("dense", "fused"):
         x = x.astype(compute_dtype)  # state rides in the wire dtype end-to-end
-    run = jax.jit(lambda x: comm.run(x, flags)[0])
-    out = run(x)
-    out.block_until_ready()  # compile + warmup
+
+    # Timing must force a (tiny) device->host readback: on tunneled backends
+    # block_until_ready() can return before execution finishes, and trusting
+    # it silently inflates throughput 100x+.  Summing an 8-column slice of
+    # the result keeps the transfer negligible while serializing on the
+    # whole chain (every output column depends on all T steps).
+    run = jax.jit(lambda x: jnp.sum(comm.run(x, flags)[0][:, :8].astype(jnp.float32)))
+    float(run(x))  # compile + warmup, forced to completion
     reps, best = 3, float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = run(x)
-        out.block_until_ready()
+        float(run(x))
         best = min(best, time.perf_counter() - t0)
     return steps / best
 
@@ -84,15 +90,19 @@ def time_backend(backend, sched, x, steps, dtype):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true")
-    p.add_argument("--backend", default="dense")
+    p.add_argument("--backend", default="fused",
+                   help="fused|dense|gather|shard_map|all; gather runs ~18 "
+                        "steps/s — pair it with --steps 200 or it takes minutes")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
-    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--steps", type=int, default=2000)
     p.add_argument("--workers", type=int, default=256)
     args = p.parse_args()
 
     sched, x, steps, dim = build(args)
 
-    backends = ["dense", "gather"] if args.backend == "all" else [args.backend]
+    # ("all" skips gather: at ~18 steps/s it would take minutes per rep;
+    #  time it separately with --backend gather --steps 200)
+    backends = ["fused", "dense"] if args.backend == "all" else [args.backend]
     results = {b: time_backend(b, sched, x, steps, args.dtype) for b in backends}
     for b, v in results.items():
         if len(backends) > 1:

@@ -28,11 +28,18 @@ StepFn = Callable[[jax.Array, Any, jax.Array], Tuple[jax.Array, Any]]
 
 @dataclasses.dataclass(frozen=True)
 class Communicator:
-    """A named (init, step) pair; ``step`` must be jit/scan-compatible."""
+    """A named (init, step) pair; ``step`` must be jit/scan-compatible.
+
+    ``multi_step``, when present, runs a whole flag stream in one fused
+    launch (e.g. the Pallas VMEM-resident gossip kernel) — arithmetically
+    equivalent to scanning ``step``, used by ``run`` for consensus-only
+    phases and the micro-benchmark.
+    """
 
     name: str
     init: Callable[[jax.Array], Any]
     step: StepFn
+    multi_step: Any = None  # Optional[(flat, carry, flags[T,M]) -> (flat, carry)]
 
     def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None):
         """Scan the communicator over a whole flag stream (consensus-only runs,
@@ -43,10 +50,17 @@ class Communicator:
         if carry is None:
             carry = self.init(flat)
 
+        flags = jnp.asarray(flags, jnp.float32)
+        if flags.shape[0] == 0:  # empty stream: identity (a zero-size Pallas
+            return flat, carry   # grid would not even initialize its output)
+
+        if self.multi_step is not None:
+            return self.multi_step(flat, carry, flags)
+
         def body(state, flags_t):
             x, c = state
             x, c = self.step(x, c, flags_t)
             return (x, c), None
 
-        (x, c), _ = lax.scan(body, (flat, carry), jnp.asarray(flags, jnp.float32))
+        (x, c), _ = lax.scan(body, (flat, carry), flags)
         return x, c

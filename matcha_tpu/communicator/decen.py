@@ -38,7 +38,10 @@ def make_decen(
 
     ``backend``:
       * ``"dense"``     — one MXU matmul per step (W_t @ x); the single-chip /
-                          feature-sharded fast path and the bench configuration.
+                          feature-sharded fast path.
+      * ``"fused"``     — dense per-step, plus the Pallas multi-step kernel
+                          (VMEM-resident state, streamed W_t stack) for whole
+                          flag streams — the bench configuration.
       * ``"gather"``    — per-matching static gathers (any N under jit).
       * ``"shard_map"`` — explicit ppermute plan over ``mesh`` (worker-sharded,
                           the physical-decentralization path where ICI carries
@@ -51,10 +54,24 @@ def make_decen(
     if backend == "auto":
         backend = "shard_map" if (mesh is not None and mesh.size > 1) else "dense"
 
+    multi_step = None
     if backend == "gather":
         mix: Callable = lambda x, w: gossip_mix(x, perms, w)
     elif backend == "dense":
         mix = dense_gossip_fn(schedule.laplacians(), compute_dtype=compute_dtype)
+    elif backend == "fused":
+        from ..parallel import build_mixing_stack, fused_gossip_run
+
+        mix = dense_gossip_fn(schedule.laplacians(), compute_dtype=compute_dtype)
+        laplacians = schedule.laplacians()
+        interpret = jax.default_backend() != "tpu"
+
+        def multi_step(flat, carry, flags):
+            stack = build_mixing_stack(
+                laplacians, alpha, flags, dtype=compute_dtype
+            )
+            return fused_gossip_run(flat, stack, interpret=interpret), carry
+
     elif backend == "shard_map":
         if mesh is None:
             raise ValueError("shard_map backend needs a mesh")
@@ -68,4 +85,6 @@ def make_decen(
     def step(flat: jax.Array, carry, flags_t: jax.Array):
         return mix(flat, alpha * flags_t), carry
 
-    return Communicator(name=f"decen[{backend}]", init=init, step=step)
+    return Communicator(
+        name=f"decen[{backend}]", init=init, step=step, multi_step=multi_step
+    )

@@ -1,6 +1,136 @@
-"""Native C++ accelerators (built lazily; Python fallbacks exist)."""
+"""Native C++ accelerators for the host-side setup path (ctypes bindings).
 
-def native_decompose_greedy(edges, size, seed):
-    """Placeholder until the C++ decomposer lands; returning None selects the
-    pure-Python fallback in topology.decompose."""
-    return None
+The reference keeps all native work in its dependencies (SURVEY.md §2.6);
+here the graph-builder itself is native:
+
+* :func:`native_edge_color` — Misra–Gries edge coloring, ≤ Δ+1 matchings,
+  deterministic (replaces the reference's unbounded randomized blossom-retry
+  decomposition, graph_manager.py:57-83 / SURVEY.md Q2).
+* :func:`native_decompose_greedy` — C++ twin of
+  ``topology.decompose_greedy`` (reference graph_manager.py:95-154).
+* :func:`native_sample_flags` — counter-based Bernoulli flag stream
+  (reference graph_manager.py:298-309), regenerable from (seed, t, j).
+
+Every entry returns ``None`` when the library is unavailable (no g++, build
+failure, or ``MATCHA_TPU_NO_NATIVE=1``) — callers fall back to Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .build import build_native
+
+__all__ = [
+    "native_available",
+    "native_edge_color",
+    "native_decompose_greedy",
+    "native_sample_flags",
+]
+
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = build_native()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        return None
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.mg_edge_color.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, i32p, i32p, ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.mg_edge_color.restype = ctypes.c_int
+    lib.greedy_decompose.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, i32p, ctypes.c_uint64, i32p,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.greedy_decompose.restype = ctypes.c_int
+    lib.sample_flag_stream.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, f64p, ctypes.c_uint64, u8p,
+    ]
+    lib.sample_flag_stream.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _edges_array(edges: Sequence[Tuple[int, int]]) -> np.ndarray:
+    arr = np.asarray(edges, dtype=np.int32)
+    if arr.size == 0:
+        arr = arr.reshape(0, 2)
+    return np.ascontiguousarray(arr)
+
+
+def _groups(edges, ids: np.ndarray, count: int) -> List[List[Tuple[int, int]]]:
+    out: List[List[Tuple[int, int]]] = [[] for _ in range(count)]
+    for (u, v), j in zip(edges, ids):
+        out[int(j)].append((min(u, v), max(u, v)))
+    return [sorted(g) for g in out if g]
+
+
+def native_edge_color(
+    edges: Sequence[Tuple[int, int]], size: int
+) -> Optional[List[List[Tuple[int, int]]]]:
+    """Decompose into ≤ Δ+1 matchings by Misra–Gries edge coloring."""
+    lib = _load()
+    if lib is None:
+        return None
+    arr = _edges_array(edges)
+    colors = np.empty(arr.shape[0], dtype=np.int32)
+    ncol = ctypes.c_int32(0)
+    rc = lib.mg_edge_color(size, arr.shape[0], arr, colors, ctypes.byref(ncol))
+    if rc != 0:
+        raise RuntimeError(f"mg_edge_color failed with code {rc}")
+    return _groups(edges, colors, int(ncol.value))
+
+
+def native_decompose_greedy(
+    edges: Sequence[Tuple[int, int]], size: int, seed: int
+) -> Optional[List[List[Tuple[int, int]]]]:
+    """Greedy maximal-matching decomposition (C++)."""
+    lib = _load()
+    if lib is None:
+        return None
+    arr = _edges_array(edges)
+    ids = np.empty(arr.shape[0], dtype=np.int32)
+    nm = ctypes.c_int32(0)
+    rc = lib.greedy_decompose(
+        size, arr.shape[0], arr, ctypes.c_uint64(seed), ids, ctypes.byref(nm)
+    )
+    if rc != 0:
+        raise RuntimeError(f"greedy_decompose failed with code {rc}")
+    return _groups(edges, ids, int(nm.value))
+
+
+def native_sample_flags(
+    probs: np.ndarray, iterations: int, seed: int
+) -> Optional[np.ndarray]:
+    """``uint8[iterations, M]`` Bernoulli(probs[j]) activation flags."""
+    lib = _load()
+    if lib is None:
+        return None
+    p = np.ascontiguousarray(np.asarray(probs, dtype=np.float64))
+    out = np.empty((iterations, p.shape[0]), dtype=np.uint8)
+    rc = lib.sample_flag_stream(
+        iterations, p.shape[0], p, ctypes.c_uint64(seed), out
+    )
+    if rc != 0:
+        raise RuntimeError(f"sample_flag_stream failed with code {rc}")
+    return out

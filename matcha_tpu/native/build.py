@@ -1,0 +1,39 @@
+"""Lazy g++ build of the native library, cached next to the source.
+
+No pybind11 in the image, so the library exposes a C ABI consumed via ctypes
+(see ``matcha_tpu/native/__init__.py``).  The build is a single translation
+unit — a plain ``g++ -O3 -shared`` is faster and simpler than dragging in
+cmake for one file.  Rebuilds happen only when the source outdates the
+cached ``.so``; set ``MATCHA_TPU_NO_NATIVE=1`` to skip native entirely
+(pure-Python fallbacks everywhere).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).parent / "src" / "matcha_native.cpp"
+_LIB = Path(__file__).parent / "_build" / "libmatcha_native.so"
+
+
+def build_native(force: bool = False) -> Optional[Path]:
+    """Compile the native library if needed; returns its path or None."""
+    if os.environ.get("MATCHA_TPU_NO_NATIVE"):
+        return None
+    if not _SRC.exists():
+        return None
+    if not force and _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _LIB
+    _LIB.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", str(_LIB), str(_SRC),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return None
+    return _LIB

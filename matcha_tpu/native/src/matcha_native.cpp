@@ -1,0 +1,295 @@
+// matcha_native — host-side C++ runtime for the TPU framework's setup path.
+//
+// The reference delegates its native work to dependencies (mpi4py/ATen/CVXOPT,
+// SURVEY.md §2.6); its own graph scheduling is pure Python
+// (/root/reference/graph_manager.py:57-154) and becomes the setup bottleneck
+// at 256+ workers.  This library provides the graph-builder equivalents:
+//
+//   * mg_edge_color       — Misra–Gries edge coloring: decomposes any simple
+//                           graph into ≤ Δ+1 matchings (provably near-optimal;
+//                           the reference's randomized blossom-retry loop has
+//                           no bound and is nondeterministic, SURVEY.md Q2).
+//   * greedy_decompose    — degree-descending greedy maximal matchings, the
+//                           native twin of topology.decompose_greedy
+//                           (reference graph_manager.py:95-154 semantics).
+//   * sample_flag_stream  — counter-based (splitmix64) Bernoulli activation
+//                           flags: deterministic by (seed, t, j) alone, so any
+//                           window of the schedule can be regenerated without
+//                           replaying an RNG sequence (reference:
+//                           graph_manager.py:298-309).
+//
+// Exposed with a plain C ABI for ctypes (no pybind11 in the image).
+// All functions return 0 on success, negative error codes otherwise.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// splitmix64 — counter-based RNG (public-domain algorithm)
+// ---------------------------------------------------------------------------
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// flags_out[t*m + j] = 1 with probability probs[j], else 0.
+int sample_flag_stream(int64_t t_steps, int64_t m, const double* probs,
+                       uint64_t seed, uint8_t* flags_out) {
+  if (t_steps < 0 || m <= 0) return -1;
+  for (int64_t t = 0; t < t_steps; ++t) {
+    for (int64_t j = 0; j < m; ++j) {
+      uint64_t z = splitmix64(seed ^ splitmix64((uint64_t)(t * m + j)));
+      double u = (double)(z >> 11) * (1.0 / 9007199254740992.0);  // [0,1)
+      double p = probs[j];
+      if (p < 0.0 || p != p) p = 0.0;  // NaN/negative clamp, reference :305-306
+      if (p > 1.0) p = 1.0;
+      flags_out[t * m + j] = u < p ? 1 : 0;
+    }
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Misra & Gries edge coloring
+// ---------------------------------------------------------------------------
+//
+// colors_out[e] ∈ [0, Δ] gives the matching id of edge e; *num_colors_out is
+// the number of matchings actually used (≤ Δ+1).
+
+int mg_edge_color(int32_t n, int64_t m, const int32_t* edges_uv,
+                  int32_t* colors_out, int32_t* num_colors_out) {
+  if (n <= 0 || m < 0) return -1;
+
+  // degree and validation
+  std::vector<int32_t> deg(n, 0);
+  for (int64_t e = 0; e < m; ++e) {
+    int32_t u = edges_uv[2 * e], v = edges_uv[2 * e + 1];
+    if (u < 0 || v < 0 || u >= n || v >= n || u == v) return -2;
+    ++deg[u];
+    ++deg[v];
+  }
+  int32_t max_deg = 0;
+  for (int32_t d : deg) max_deg = std::max(max_deg, d);
+  const int32_t C = max_deg + 1;  // palette size; result uses ≤ C colors
+
+  // at[u*C + c] = partner of u on the edge colored c, or -1
+  std::vector<int32_t> at((size_t)n * C, -1);
+  // pair -> edge id (O(1) per-edge color bookkeeping); key = lo*n + hi
+  std::unordered_map<uint64_t, int64_t> eid;
+  eid.reserve((size_t)m * 2);
+  for (int64_t e = 0; e < m; ++e) {
+    int32_t u = edges_uv[2 * e], v = edges_uv[2 * e + 1];
+    uint64_t key = (uint64_t)std::min(u, v) * (uint64_t)n + std::max(u, v);
+    if (!eid.emplace(key, e).second) return -2;  // duplicate edge
+  }
+  std::vector<int32_t> ecol(m, -1);  // per-edge color
+  auto edge_key = [&](int32_t u, int32_t v) {
+    return (uint64_t)std::min(u, v) * (uint64_t)n + std::max(u, v);
+  };
+  auto set_color = [&](int32_t u, int32_t v, int32_t c) {
+    at[(size_t)u * C + c] = v;
+    at[(size_t)v * C + c] = u;
+    ecol[eid.find(edge_key(u, v))->second] = c;
+  };
+  auto clear_color = [&](int32_t u, int32_t v, int32_t c) {
+    at[(size_t)u * C + c] = -1;
+    at[(size_t)v * C + c] = -1;
+    ecol[eid.find(edge_key(u, v))->second] = -1;
+  };
+  auto color_of = [&](int32_t u, int32_t v) -> int32_t {
+    return ecol[eid.find(edge_key(u, v))->second];
+  };
+  auto free_color = [&](int32_t u) -> int32_t {
+    for (int32_t c = 0; c < C; ++c)
+      if (at[(size_t)u * C + c] < 0) return c;
+    return -1;  // cannot happen: deg(u) ≤ Δ < C
+  };
+  auto is_free = [&](int32_t u, int32_t c) {
+    return at[(size_t)u * C + c] < 0;
+  };
+
+  std::vector<int32_t> fan;
+  fan.reserve(max_deg);
+  std::vector<char> in_fan(n, 0);  // cleared per edge via fan entries
+
+  for (int64_t e = 0; e < m; ++e) {
+    const int32_t u = edges_uv[2 * e];
+    const int32_t v = edges_uv[2 * e + 1];
+
+    // --- maximal fan of u starting at v ------------------------------------
+    // fan[i+1] is a neighbor of u via a *colored* edge whose color is free
+    // on fan[i].  Track which neighbors are already in the fan.
+    for (int32_t w : fan) in_fan[w] = 0;  // clear previous edge's marks
+    fan.clear();
+    fan.push_back(v);
+    in_fan[v] = 1;
+    bool grew = true;
+    while (grew) {
+      grew = false;
+      int32_t tail = fan.back();
+      for (int32_t c = 0; c < C; ++c) {
+        int32_t w = at[(size_t)u * C + c];  // neighbor via color c
+        if (w >= 0 && !in_fan[w] && is_free(tail, c)) {
+          fan.push_back(w);
+          in_fan[w] = 1;
+          grew = true;
+          break;
+        }
+      }
+    }
+
+    const int32_t c_free_u = free_color(u);
+    int32_t d = free_color(fan.back());
+    if (c_free_u < 0 || d < 0) return -3;
+
+    // --- invert the cd_u path ----------------------------------------------
+    // Maximal alternating path starting at u with colors (d, c, d, ...).
+    // Collect first, flip after: flipping mid-walk corrupts the `at` lookups
+    // the walk itself uses.  No cycle is possible through u because c is
+    // free there, so the walk terminates.
+    if (c_free_u != d) {
+      struct PathEdge { int32_t a, b, color; };
+      std::vector<PathEdge> path;
+      int32_t a = u, cur = d;
+      while (true) {
+        int32_t b = at[(size_t)a * C + cur];
+        if (b < 0) break;
+        path.push_back({a, b, cur});
+        a = b;
+        cur = (cur == d) ? c_free_u : d;
+      }
+      for (auto& pe : path) clear_color(pe.a, pe.b, pe.color);
+      for (auto& pe : path)
+        set_color(pe.a, pe.b, pe.color == d ? c_free_u : d);
+    }
+
+    // --- find w in fan with d free, rotate prefix, color (u,w) with d ------
+    // After path inversion the fan may no longer be a fan past some point;
+    // take the longest prefix that is still a fan and whose tip has d free.
+    int32_t w_idx = -1;
+    for (int32_t i = (int32_t)fan.size() - 1; i >= 0; --i) {
+      if (is_free(fan[i], d)) {
+        // check prefix fan validity: for i>0 the edge (u, fan[k]) color must
+        // be free on fan[k-1] — preserved for k ≤ i by construction, except
+        // where inversion touched it; re-verify cheaply.
+        bool ok = true;
+        for (int32_t k = 1; k <= i; ++k) {
+          int32_t ck = color_of(u, fan[k]);
+          if (ck < 0 || !is_free(fan[k - 1], ck)) {
+            ok = false;
+            break;
+          }
+        }
+        if (ok) {
+          w_idx = i;
+          break;
+        }
+      }
+    }
+    if (w_idx < 0) return -4;  // violates Vizing invariant — algorithm bug
+
+    // rotate: shift each fan edge's color down one slot
+    for (int32_t k = 0; k < w_idx; ++k) {
+      int32_t ck1 = color_of(u, fan[k + 1]);
+      clear_color(u, fan[k + 1], ck1);
+      set_color(u, fan[k], ck1);
+    }
+    set_color(u, fan[w_idx], d);
+  }
+
+  int32_t used = 0;
+  for (int64_t e = 0; e < m; ++e) {
+    int32_t c = ecol[e];
+    if (c < 0) return -5;
+    colors_out[e] = c;
+    used = std::max(used, c + 1);
+  }
+  *num_colors_out = used;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Greedy maximal-matching decomposition (reference graph_manager.py:95-154)
+// ---------------------------------------------------------------------------
+//
+// matching_id_out[e] = pass index in which edge e was matched.
+
+int greedy_decompose(int32_t n, int64_t m, const int32_t* edges_uv,
+                     uint64_t seed, int32_t* matching_id_out,
+                     int32_t* num_matchings_out) {
+  if (n <= 0 || m < 0) return -1;
+
+  // adjacency as edge lists
+  std::vector<std::vector<std::pair<int32_t, int64_t>>> adj(n);  // (nbr, edge)
+  for (int64_t e = 0; e < m; ++e) {
+    int32_t u = edges_uv[2 * e], v = edges_uv[2 * e + 1];
+    if (u < 0 || v < 0 || u >= n || v >= n || u == v) return -2;
+    adj[u].push_back({v, e});
+    adj[v].push_back({u, e});
+    matching_id_out[e] = -1;
+  }
+
+  // seeded tie-break permutation (mirrors decompose_greedy's rng.permutation)
+  std::vector<int32_t> tie(n);
+  std::iota(tie.begin(), tie.end(), 0);
+  for (int32_t i = n - 1; i > 0; --i) {
+    uint64_t z = splitmix64(seed ^ splitmix64((uint64_t)i));
+    std::swap(tie[i], tie[z % (uint64_t)(i + 1)]);
+  }
+
+  std::vector<int32_t> deg(n);
+  std::vector<int32_t> order(n);
+  std::vector<char> used(n);
+  int64_t remaining = m;
+  int32_t pass = 0;
+
+  while (remaining > 0) {
+    for (int32_t i = 0; i < n; ++i) {
+      deg[i] = 0;
+      for (auto& [nbr, e] : adj[i])
+        if (matching_id_out[e] < 0) ++deg[i];
+    }
+    std::iota(order.begin(), order.end(), 0);
+    std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+      if (deg[a] != deg[b]) return deg[a] > deg[b];
+      return tie[a] < tie[b];
+    });
+    std::fill(used.begin(), used.end(), 0);
+
+    int64_t matched_this_pass = 0;
+    for (int32_t u : order) {
+      if (used[u] || deg[u] == 0) continue;
+      // partner = unmatched neighbor of highest remaining degree
+      int32_t best = -1;
+      int64_t best_e = -1;
+      for (auto& [w, e] : adj[u]) {
+        if (matching_id_out[e] >= 0 || used[w]) continue;
+        if (best < 0 || deg[w] > deg[best] ||
+            (deg[w] == deg[best] && tie[w] > tie[best])) {
+          best = w;
+          best_e = e;
+        }
+      }
+      if (best < 0) continue;
+      matching_id_out[best_e] = pass;
+      used[u] = used[best] = 1;
+      ++matched_this_pass;
+    }
+    if (matched_this_pass == 0) return -3;  // stalled: impossible on simple graph
+    remaining -= matched_this_pass;
+    ++pass;
+  }
+  *num_matchings_out = pass;
+  return 0;
+}
+
+}  // extern "C"

@@ -11,10 +11,13 @@ from .gossip import (
     shard_map_gossip_fn,
 )
 from .mesh import WORKER_AXIS, fold_dims, replicated, shard_workers, worker_mesh
+from .pallas_gossip import build_mixing_stack, fused_gossip_run
 
 __all__ = [
     "WORKER_AXIS",
     "FoldedPlan",
+    "build_mixing_stack",
+    "fused_gossip_run",
     "allreduce_mean",
     "broadcast_worker0",
     "build_folded_plan",

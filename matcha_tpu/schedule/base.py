@@ -36,12 +36,28 @@ from .solvers import contraction_rho
 __all__ = ["Schedule", "sample_flags"]
 
 
-def sample_flags(probs: np.ndarray, iterations: int, seed: int) -> np.ndarray:
+def sample_flags(
+    probs: np.ndarray, iterations: int, seed: int, sampler: str = "numpy"
+) -> np.ndarray:
     """i.i.d. Bernoulli(probs[j]) activation flags, ``uint8[iterations, M]``.
 
     Parity with ``MatchaProcessor.set_flags`` (graph_manager.py:298-309),
     including the NaN/negative clamp to probability 0.
+
+    ``sampler="native"`` uses the C++ counter-based stream (splitmix64 keyed
+    by ``(seed, t, j)``): any window of the schedule can be regenerated
+    without replaying an RNG sequence — what checkpoint-resume at step k and
+    schedule extension both want.  Falls back to numpy when the native
+    library is unavailable (different stream, same statistics).
     """
+    if sampler == "native":
+        from ..native import native_sample_flags
+
+        flags = native_sample_flags(probs, iterations, seed)
+        if flags is not None:
+            return flags
+    elif sampler != "numpy":
+        raise KeyError(f"unknown flag sampler '{sampler}'")
     p = np.asarray(probs, dtype=np.float64).copy()
     p[~np.isfinite(p)] = 0.0
     p = np.clip(p, 0.0, 1.0)

@@ -36,6 +36,7 @@ def fixed_schedule(
     mode: str = "all",
     seed: int = 0,
     alpha: float | None = None,
+    flag_sampler: str = "numpy",
 ) -> Schedule:
     """Build a D-PSGD schedule over a pre-decomposed graph.
 
@@ -55,7 +56,7 @@ def fixed_schedule(
         flags = np.ones((iterations, M), dtype=np.uint8)
     elif mode == "bernoulli":
         probs = np.full(M, float(budget))
-        flags = sample_flags(probs, iterations, seed)
+        flags = sample_flags(probs, iterations, seed, sampler=flag_sampler)
     elif mode == "alternating":
         if M != 2:
             raise ValueError(

@@ -36,6 +36,7 @@ def matcha_schedule(
     redecompose: bool = False,
     decompose_method: str = "auto",
     solver_iters: int = 3000,
+    flag_sampler: str = "numpy",
 ) -> Schedule:
     """Build a MATCHA schedule.
 
@@ -64,7 +65,7 @@ def matcha_schedule(
             f"(budget={budget}); consensus will not converge. Raise the budget."
         )
 
-    flags = sample_flags(probs, iterations, seed)
+    flags = sample_flags(probs, iterations, seed, sampler=flag_sampler)
     return Schedule(
         perms=matchings_to_perms(decomposed, size),
         alpha=float(alpha),

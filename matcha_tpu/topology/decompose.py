@@ -129,24 +129,35 @@ def decompose(
 ) -> DecomposedGraph:
     """Decompose a base graph into matchings.
 
-    ``method``: ``"extract"`` (blossom, fewest matchings), ``"greedy"``
-    (fast, native-accelerated), or ``"auto"`` — extract for small graphs,
-    native greedy for large ones where the blossom loop gets slow.
+    ``method``:
+      * ``"color"``   — native Misra–Gries edge coloring: ≤ Δ+1 matchings,
+                        deterministic, O(V·E); the best quality/speed point
+                        (falls back to ``greedy`` without the C++ library —
+                        same asymptotics, slightly more matchings).
+      * ``"extract"`` — repeated maximum matchings (blossom); few matchings
+                        but slow on large graphs.
+      * ``"greedy"``  — degree-descending greedy passes (native-accelerated).
+      * ``"auto"``    — extract for small graphs, color for large ones.
     """
     if method == "auto":
-        method = "extract" if size <= 64 else "greedy"
+        method = "extract" if size <= 64 else "color"
+    if method == "color":
+        from ..native import native_edge_color
+
+        result = native_edge_color(_dedup(edges), size)
+        if result is None:
+            return decompose_greedy(edges, size, seed)
+        validate_decomposition(result, size, base_edges=_dedup(edges))
+        return result
     if method == "extract":
         return decompose_extract(edges, size, seed)
     if method == "greedy":
-        try:
-            from ..native import native_decompose_greedy
+        from ..native import native_decompose_greedy
 
-            result = native_decompose_greedy(edges, size, seed)
-            if result is not None:
-                validate_decomposition(result, size, base_edges=_dedup(edges))
-                return result
-        except ImportError:
-            pass
+        result = native_decompose_greedy(edges, size, seed)
+        if result is not None:
+            validate_decomposition(result, size, base_edges=_dedup(edges))
+            return result
         return decompose_greedy(edges, size, seed)
     raise KeyError(f"unknown decomposition method '{method}'")
 

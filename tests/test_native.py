@@ -1,0 +1,105 @@
+"""Native C++ graph-builder: edge coloring, greedy decomposition, flags.
+
+These tests build the library on first use (g++ is in the image); if the
+build is unavailable the module contract is to return None, which we assert
+is NOT the case here — CI must exercise the native path.
+"""
+
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+from matcha_tpu.native import (
+    native_available,
+    native_decompose_greedy,
+    native_edge_color,
+    native_sample_flags,
+)
+from matcha_tpu.topology import validate_decomposition
+
+pytestmark = pytest.mark.skipif(not native_available(), reason="no native lib")
+
+
+def _random_graph(n, p, seed):
+    rng = np.random.default_rng(seed)
+    edges = [(i, j) for i in range(n) for j in range(i + 1, n) if rng.random() < p]
+    return edges
+
+
+def _max_degree(edges, n):
+    deg = np.zeros(n, dtype=int)
+    for (u, v) in edges:
+        deg[u] += 1
+        deg[v] += 1
+    return int(deg.max()) if len(edges) else 0
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("n,p", [(8, 0.4), (16, 0.3), (32, 0.2), (64, 0.1)])
+def test_edge_color_is_valid_and_bounded(n, p, seed):
+    edges = _random_graph(n, p, seed)
+    if not edges:
+        pytest.skip("empty graph")
+    dec = native_edge_color(edges, n)
+    validate_decomposition(dec, n, base_edges=[(min(u, v), max(u, v)) for u, v in edges])
+    assert len(dec) <= _max_degree(edges, n) + 1  # Vizing bound
+
+
+def test_edge_color_deterministic():
+    edges = _random_graph(24, 0.3, 7)
+    assert native_edge_color(edges, 24) == native_edge_color(edges, 24)
+
+
+def test_edge_color_zoo_graphs():
+    for gid in range(6):
+        dec0 = tp.select_graph(gid)
+        n = tp.graph_size(gid)
+        edges = tp.union_edges(dec0)
+        dec = native_edge_color(edges, n)
+        validate_decomposition(dec, n, base_edges=edges)
+        assert len(dec) <= _max_degree(edges, n) + 1
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_greedy_native_valid(seed):
+    edges = _random_graph(20, 0.3, 100 + seed)
+    if not edges:
+        pytest.skip("empty graph")
+    dec = native_decompose_greedy(edges, 20, seed)
+    validate_decomposition(dec, 20, base_edges=[(min(u, v), max(u, v)) for u, v in edges])
+
+
+def test_greedy_native_deterministic_by_seed():
+    edges = _random_graph(20, 0.3, 5)
+    a = native_decompose_greedy(edges, 20, 1)
+    b = native_decompose_greedy(edges, 20, 1)
+    assert a == b
+
+
+def test_decompose_color_method_used():
+    edges = tp.ring_graph(128)
+    dec = tp.decompose(edges, 128, method="color")
+    validate_decomposition(dec, 128, base_edges=edges)
+    assert len(dec) <= 3  # ring has Δ=2
+
+
+def test_flag_stream_stats_and_clamps():
+    probs = np.array([0.5, 1.0, 0.0, -0.3, np.nan])
+    f = native_sample_flags(probs, 20000, 3)
+    assert f.shape == (20000, 5)
+    means = f.mean(axis=0)
+    assert abs(means[0] - 0.5) < 0.02
+    assert means[1] == 1.0
+    assert means[2] == 0.0
+    assert means[3] == 0.0  # negative clamps to 0 (reference :305-306)
+    assert means[4] == 0.0  # NaN clamps to 0
+    assert (f == native_sample_flags(probs, 20000, 3)).all()
+    assert not (f == native_sample_flags(probs, 20000, 4)).all()
+
+
+def test_flag_stream_windows_composable():
+    # counter-based: a longer stream's prefix equals the shorter stream
+    probs = np.array([0.3, 0.7])
+    short = native_sample_flags(probs, 100, 9)
+    long = native_sample_flags(probs, 200, 9)
+    assert (long[:100] == short).all()

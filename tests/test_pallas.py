@@ -1,0 +1,84 @@
+"""Pallas fused gossip kernel vs the per-step dense backend.
+
+On CPU the kernel runs under the Pallas interpreter (same program, no
+Mosaic); arithmetic must match a lax.scan over ``gossip_mix_dense``
+step-for-step in f32.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from matcha_tpu import topology as tp
+from matcha_tpu.communicator import make_decen
+from matcha_tpu.parallel import build_mixing_stack, fused_gossip_run
+from matcha_tpu.schedule import matcha_schedule
+
+
+def _schedule(n=8, iterations=12, budget=0.6):
+    edges = tp.ring_graph(n)
+    dec = tp.decompose(edges, n, seed=0)
+    return matcha_schedule(dec, n, iterations=iterations, budget=budget, seed=0)
+
+
+def test_fused_matches_dense_scan():
+    sched = _schedule()
+    n = sched.perms.shape[1]
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 40)), jnp.float32)
+    flags = jnp.asarray(sched.flags, jnp.float32)
+
+    dense = make_decen(sched, backend="dense")
+    fused = make_decen(sched, backend="fused")
+    assert fused.multi_step is not None
+
+    xd, _ = dense.run(x, flags)
+    xf, _ = fused.run(x, flags)
+    np.testing.assert_allclose(np.asarray(xd), np.asarray(xf), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_matches_dense_scan_mixed_dtype():
+    # f32 state with bf16 wire dtype: fused must round the state into bf16 at
+    # each step's input exactly like gossip_mix_dense
+    sched = _schedule()
+    n = sched.perms.shape[1]
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(n, 33)), jnp.float32)
+    flags = jnp.asarray(sched.flags, jnp.float32)
+    dense = make_decen(sched, backend="dense", compute_dtype=jnp.bfloat16)
+    fused = make_decen(sched, backend="fused", compute_dtype=jnp.bfloat16)
+    xd, _ = dense.run(x, flags)
+    xf, _ = fused.run(x, flags)
+    assert xf.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(xd), np.asarray(xf), rtol=0, atol=0)
+
+
+def test_mixing_stack_rows_sum_to_one():
+    sched = _schedule()
+    stack = np.asarray(
+        build_mixing_stack(sched.laplacians(), sched.alpha, sched.flags, jnp.float32)
+    )
+    # every W_t is symmetric doubly-stochastic-by-construction: rows sum to 1
+    np.testing.assert_allclose(stack.sum(axis=-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(stack, np.swapaxes(stack, -1, -2), atol=1e-6)
+
+
+def test_fused_block_boundary():
+    # D not divisible by block_d exercises the padded edge block
+    sched = _schedule(iterations=5)
+    n = sched.perms.shape[1]
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(n, 37)), jnp.float32)
+    stack = build_mixing_stack(sched.laplacians(), sched.alpha, sched.flags, jnp.float32)
+    out = fused_gossip_run(x, stack, block_d=16, interpret=True)
+    ref = x
+    for t in range(stack.shape[0]):
+        ref = jnp.dot(stack[t], ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_empty_flag_stream_is_identity():
+    sched = _schedule(iterations=3)
+    n = sched.perms.shape[1]
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(n, 10)), jnp.float32)
+    empty = np.zeros((0, sched.flags.shape[1]), np.float32)
+    for backend in ("dense", "fused", "gather"):
+        out, _ = make_decen(sched, backend=backend).run(x, empty)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
