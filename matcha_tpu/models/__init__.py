@@ -7,14 +7,16 @@ from .registry import (
     dataset_num_classes,
     select_model,
 )
-from .resnet import ResNet, resnet_config
+from .resnet import ResNet, ResNetImageNet, resnet_config, resnet_imagenet_config
 from .vgg import VGG, vgg_config
 from .wrn import WideResNet
 
 __all__ = [
     "MLP",
     "ResNet",
+    "ResNetImageNet",
     "VGG",
+    "resnet_imagenet_config",
     "WideResNet",
     "available_models",
     "dataset_input_shape",

@@ -18,7 +18,7 @@ from typing import Any, Tuple
 import flax.linen as nn
 
 from .mlp import MLP
-from .resnet import ResNet
+from .resnet import ResNet, ResNetImageNet
 from .vgg import VGG
 from .wrn import WideResNet
 
@@ -72,11 +72,17 @@ def select_model(
         kw["dtype"] = dtype
 
     lname = name.lower()
-    if name == "res":  # reference depth policy (util.py:258-264)
+    if name == "res":  # reference depth policy (util.py:258-265)
+        if dataset == "imagenet":  # torchvision resnet18 path (util.py:262)
+            return ResNetImageNet(depth=18, num_classes=classes, **kw)
         depth = 50 if dataset == "cifar10" else 18
         return ResNet(depth=depth, num_classes=classes, **kw)
     if lname.startswith("resnet"):
-        return ResNet(depth=int(lname[len("resnet"):]), num_classes=classes, **kw)
+        depth = int(lname[len("resnet"):])
+        # imagenet gets the 4-stage 7x7-stem layout, CIFAR the 3-stage one
+        if dataset == "imagenet":
+            return ResNetImageNet(depth=depth, num_classes=classes, **kw)
+        return ResNet(depth=depth, num_classes=classes, **kw)
     if name == "VGG" or lname == "vgg":
         return VGG(depth=16, num_classes=classes, **kw)
     if lname.startswith("vgg"):

@@ -22,7 +22,7 @@ from typing import Any, Callable, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-__all__ = ["ResNet", "resnet_config"]
+__all__ = ["ResNet", "ResNetImageNet", "resnet_config", "resnet_imagenet_config"]
 
 
 def resnet_config(depth: int) -> Tuple[str, Sequence[int]]:
@@ -111,4 +111,47 @@ class ResNet(nn.Module):
                 x = block(planes=planes, stride=stride if b == 0 else 1,
                           dtype=self.dtype, name=f"stage{stage}_block{b}")(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average over the 8x8 map
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def resnet_imagenet_config(depth: int) -> Tuple[str, Sequence[int]]:
+    """(block_kind, blocks_per_stage) for the 4-stage ImageNet layout."""
+    table = {
+        18: ("basic", (2, 2, 2, 2)),
+        34: ("basic", (3, 4, 6, 3)),
+        50: ("bottleneck", (3, 4, 6, 3)),
+        101: ("bottleneck", (3, 4, 23, 3)),
+        152: ("bottleneck", (3, 8, 36, 3)),
+    }
+    if depth not in table:
+        raise ValueError(f"unsupported ImageNet ResNet depth {depth}: need {sorted(table)}")
+    return table[depth]
+
+
+class ResNetImageNet(nn.Module):
+    """4-stage ImageNet ResNet (7×7/2 stem + 3×3/2 max pool, 64/128/256/512
+    planes, global average pool) — the layout the reference reaches through
+    ``torchvision.models.resnet18()`` for its imagenet config
+    (/root/reference/util.py:262-265).  Input NHWC, e.g. ``[B, 224, 224, 3]``.
+    """
+
+    depth: int = 18
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        kind, blocks = resnet_imagenet_config(self.depth)
+        block: Callable = BasicBlock if kind == "basic" else Bottleneck
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=True,
+                    dtype=self.dtype, name="stem")(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype, name="stem_bn")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, planes in enumerate((64, 128, 256, 512)):
+            for b in range(blocks[stage]):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = block(planes=planes, stride=stride, dtype=self.dtype,
+                          name=f"stage{stage}_block{b}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

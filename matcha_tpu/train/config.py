@@ -67,6 +67,7 @@ class TrainConfig:
     # execution
     scan_epoch: bool = True  # lax.scan over an epoch's batches (one program)
     devices: Optional[int] = None  # mesh size; None → all available
+    measure_comm_split: bool = True  # two-program comp/comm timing (§5.1)
 
     def __post_init__(self):
         if self.communicator not in ("decen", "choco", "centralized", "none"):

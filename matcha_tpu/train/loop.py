@@ -10,9 +10,10 @@ initial replicas, then runs the epoch loop.  Differences by design:
   python loop is kept for debugging.
 * comp/comm wall-clock split: XLA fuses compute and communication, so the
   reference's timer-around-sendrecv (train_mpi.py:138-143) cannot be
-  reproduced literally.  We time the epoch and attribute the share measured
-  by a separate gossip-only microbenchmark at setup (first epoch), which is
-  also what `bench.py` reports.
+  reproduced literally.  Two-program split instead (SURVEY.md §5.1): each
+  epoch's gossip chain is re-run in isolation (short sampled window, scaled)
+  and its wall-clock is charged to ``comm_time``; ``comp_time`` is the
+  remainder of the epoch.
 """
 
 from __future__ import annotations
@@ -161,6 +162,16 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if config.scan_epoch:
         scan_step = _make_epoch_scan(step_fn)
 
+    # comp/comm split (SURVEY.md §5.1): XLA fuses gossip into the train step,
+    # so the reference's timer-around-sendrecv (train_mpi.py:138-143) cannot
+    # bracket it.  Two-program split instead: re-run the epoch's gossip chain
+    # in isolation on the current flat parameter stack and charge its
+    # wall-clock to comm_time.  Costs one extra gossip chain per epoch
+    # (a few % of the epoch); disable with measure_comm_split=False.
+    comm_timer = None
+    if config.measure_comm_split and config.communicator != "none":
+        comm_timer = _make_comm_timer(communicator, flattener)
+
     for epoch in range(start_epoch, config.epochs):
         t0 = time.time()
         if config.scan_epoch:
@@ -179,6 +190,11 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         jax.block_until_ready(state.params)
         epoch_time = time.time() - t0
 
+        comm_time = 0.0
+        if comm_timer is not None:
+            window = schedule.flags[epoch * bpe : (epoch + 1) * bpe]
+            comm_time = min(comm_timer(state, window), epoch_time)
+
         # evaluation: every worker on the full test set (train_mpi.py:152)
         test_loss = test_acc = np.zeros(config.num_workers)
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
@@ -188,8 +204,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
         recorder.add_epoch(
             epoch_time=epoch_time,
-            comp_time=epoch_time,  # see module docstring: split measured by bench
-            comm_time=0.0,
+            comp_time=epoch_time - comm_time,
+            comm_time=comm_time,
             train_acc=epoch_metrics["accuracy"],
             train_loss=epoch_metrics["loss"],
             test_acc=test_acc,
@@ -201,6 +217,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             "test_acc_mean": float(np.mean(test_acc)),
             "test_loss_mean": float(np.mean(test_loss)),
             "epoch_time": epoch_time,
+            "comm_time": comm_time,
         })
 
         if config.save and recorder.epochs_recorded % 10 == 0:
@@ -211,6 +228,30 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if config.save:
         recorder.save()
     return TrainResult(state, recorder, schedule, history)
+
+
+def _make_comm_timer(communicator, flattener, sample_steps: int = 32):
+    """Jitted gossip-only chain, timed with a forced scalar readback
+    (block_until_ready alone is unreliable on tunneled backends — see
+    bench.py).  Times a ``sample_steps``-long window of the epoch's flags and
+    scales linearly — the chain is step-homogeneous, and the short window
+    keeps the extra compile cheap."""
+    @jax.jit
+    def chain(params, carry, flags):
+        flat = flattener.flatten(params)
+        out, _ = communicator.run(flat, flags, carry)
+        return jnp.sum(out[:, :1].astype(jnp.float32))
+
+    def timer(state, flags_window) -> float:
+        n = len(flags_window)
+        k = min(sample_steps, n)
+        flags = jnp.asarray(flags_window[:k], jnp.float32)
+        float(chain(state.params, state.comm_carry, flags))  # warm/compile
+        t0 = time.time()
+        float(chain(state.params, state.comm_carry, flags))
+        return (time.time() - t0) * (n / k)
+
+    return timer
 
 
 def _make_epoch_scan(step_fn):

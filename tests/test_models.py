@@ -127,3 +127,26 @@ def test_jit_forward():
     fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
     out = fwd(variables, x)
     assert out.shape == (2, 10)
+
+
+def test_imagenet_resnet18_layout_and_registry():
+    from matcha_tpu.models import ResNetImageNet, resnet_imagenet_config
+
+    assert resnet_imagenet_config(18) == ("basic", (2, 2, 2, 2))
+    assert resnet_imagenet_config(50) == ("bottleneck", (3, 4, 6, 3))
+    with pytest.raises(ValueError):
+        resnet_imagenet_config(20)  # 6n+2 family is CIFAR-only
+
+    # reference policy: 'res' on imagenet -> torchvision resnet18 layout
+    # (util.py:262-265); explicit resnet names also switch layout by dataset
+    m = select_model("res", "imagenet")
+    assert isinstance(m, ResNetImageNet) and m.depth == 18
+    assert m.num_classes == 1000
+    assert isinstance(select_model("resnet50", "imagenet"), ResNetImageNet)
+
+    # small spatial input keeps the test cheap; stem/2 + pool/2 + 3 stage
+    # strides -> /32 overall, so 64x64 input pools a 2x2 map
+    x = jnp.ones((2, 64, 64, 3))
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    out = m.apply(variables, x, train=False)
+    assert out.shape == (2, 1000)

@@ -32,6 +32,9 @@ BASE = TrainConfig(
     seed=3,
     save=False,
     eval_every=1,
+    # the comp/comm split costs one extra jit per train() call — measured in
+    # its own dedicated test below, off everywhere else to keep CI fast
+    measure_comm_split=False,
 )
 
 
@@ -134,3 +137,17 @@ def test_recorder_writes_reference_compatible_logs(tmp_path):
     # one line per epoch
     lines = (folder / f"dsgd-lr{cfg.lr}-budget{cfg.budget}-r3-losses.log").read_text().strip().splitlines()
     assert len(lines) == 1
+
+
+def test_comm_split_measured():
+    # two-program comp/comm split (SURVEY.md §5.1): comm_time is measured by
+    # re-running the epoch's gossip chain in isolation; it must be positive,
+    # bounded by the epoch, and comp+comm must reassemble the epoch time
+    cfg = dataclasses.replace(BASE, epochs=1, measure_comm_split=True)
+    r = train(cfg)
+    comm = r.history[0]["comm_time"]
+    assert 0 < comm <= r.history[0]["epoch_time"]
+    rec = r.recorder
+    assert rec.data["comptime"][0] + rec.data["commtime"][0] == pytest.approx(
+        rec.data["time"][0]
+    )
