@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Run the five BASELINE.json reference configurations end-to-end.
+
+The reference publishes no numbers (BASELINE.md), so what this harness
+establishes is that every configuration the reference can express runs in
+this framework, and what its measured comp/comm/epoch split and accuracy
+trajectory are on the current hardware.  Real CIFAR/ImageNet data is not
+downloadable in this environment; ``--scale smoke`` substitutes synthetic
+datasets with the right input shapes and shrinks epochs, which exercises the
+identical compiled program shapes (model × workers × schedule) at a fraction
+of the wall-clock.  Pass ``--scale full --data-root <npz dir>`` on a machine
+with the real datasets.
+
+Output: one JSON line per config with the recorder's series.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from matcha_tpu.train import TrainConfig, train  # noqa: E402
+
+# The five reference configs (BASELINE.md; reference flags in parentheses).
+CONFIGS = {
+    # 1. ResNet / CIFAR-10, 8 workers, D-PSGD FixedProcessor graphid 0
+    "dpsgd-resnet-cifar10-8w": TrainConfig(
+        name="dpsgd-resnet-cifar10-8w", model="res", dataset="cifar10",
+        num_workers=8, graphid=0, matcha=False, fixed_mode="all",
+        lr=0.8, batch_size=32,
+    ),
+    # 2. VGG-16 / CIFAR-10, 8 workers, MATCHA budget 0.5
+    "matcha-vgg16-cifar10-8w": TrainConfig(
+        name="matcha-vgg16-cifar10-8w", model="VGG", dataset="cifar10",
+        num_workers=8, graphid=0, matcha=True, budget=0.5,
+        lr=0.8, batch_size=32,
+    ),
+    # 3. WRN-28-10 / CIFAR-100, 16 workers, MATCHA on the ER graph (zoo id 4)
+    "matcha-wrn-cifar100-16w": TrainConfig(
+        name="matcha-wrn-cifar100-16w", model="wrn", dataset="cifar100",
+        num_workers=16, graphid=4, matcha=True, budget=0.5,
+        lr=0.8, batch_size=32,
+    ),
+    # 4. ResNet / CIFAR-10, 64 workers, CHOCO + top-k
+    "choco-resnet-cifar10-64w": TrainConfig(
+        name="choco-resnet-cifar10-64w", model="resnet20", dataset="cifar10",
+        num_workers=64, graphid=None, topology="geometric",
+        matcha=True, budget=0.5, communicator="choco", compress_ratio=0.9,
+        lr=0.8, batch_size=32,
+    ),
+    # 5. ResNet-50 / ImageNet, 256 workers, MATCHA sweep point
+    "matcha-resnet50-imagenet-256w": TrainConfig(
+        name="matcha-resnet50-imagenet-256w", model="resnet50",
+        dataset="imagenet", num_workers=256, graphid=None,
+        topology="geometric", matcha=True, budget=0.5,
+        lr=0.8, batch_size=8,
+    ),
+}
+
+SMOKE_OVERRIDES = {
+    # synthetic stand-ins with the dataset's input shape; tiny epochs
+    "dpsgd-resnet-cifar10-8w": dict(dataset="synthetic_image", epochs=2),
+    "matcha-vgg16-cifar10-8w": dict(dataset="synthetic_image", epochs=2),
+    "matcha-wrn-cifar100-16w": dict(dataset="synthetic_image", epochs=1,
+                                    batch_size=8),
+    "choco-resnet-cifar10-64w": dict(dataset="synthetic_image", epochs=1,
+                                     batch_size=8),
+    "matcha-resnet50-imagenet-256w": dict(dataset="synthetic_image", epochs=1,
+                                          batch_size=2, model="resnet20",
+                                          num_workers=64),
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", choices=["smoke", "full"], default="smoke")
+    p.add_argument("--data-root", default=None, help="dir of .npz datasets (full scale)")
+    p.add_argument("--only", default=None, help="comma-separated config names")
+    args = p.parse_args()
+
+    names = list(CONFIGS) if args.only is None else args.only.split(",")
+    for cname in names:
+        cfg = CONFIGS[cname]
+        if args.scale == "smoke":
+            cfg = dataclasses.replace(cfg, warmup=False, seed=0,
+                                      **SMOKE_OVERRIDES[cname])
+        elif args.data_root is not None:
+            cfg = dataclasses.replace(
+                cfg, datasetRoot=os.path.join(args.data_root, f"{cfg.dataset}.npz")
+            )
+        t0 = time.time()
+        result = train(cfg)
+        hist = result.history
+        print(json.dumps({
+            "config": cname,
+            "scale": args.scale,
+            "epochs": len(hist),
+            "wall_s": round(time.time() - t0, 2),
+            "final_loss": round(hist[-1]["loss"], 4),
+            "final_test_acc": round(hist[-1]["test_acc_mean"], 4),
+            "epoch_time_s": round(hist[-1]["epoch_time"], 3),
+            "comm_time_s": round(hist[-1]["comm_time"], 3),
+            "comm_share": round(
+                hist[-1]["comm_time"] / max(hist[-1]["epoch_time"], 1e-9), 4
+            ),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,12 @@ from .datasets import (
     synthetic_classification,
     synthetic_images,
 )
-from .partition import partition_indices, partition_label_skew, partition_uniform
+from .partition import (
+    partition_fractions,
+    partition_indices,
+    partition_label_skew,
+    partition_uniform,
+)
 
 __all__ = [
     "Dataset",
@@ -21,6 +26,7 @@ __all__ = [
     "load_npz",
     "normalize",
     "normalized_zero",
+    "partition_fractions",
     "partition_indices",
     "partition_label_skew",
     "partition_uniform",

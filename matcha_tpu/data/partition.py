@@ -21,7 +21,12 @@ from typing import List
 
 import numpy as np
 
-__all__ = ["partition_uniform", "partition_label_skew", "partition_indices"]
+__all__ = [
+    "partition_uniform",
+    "partition_fractions",
+    "partition_label_skew",
+    "partition_indices",
+]
 
 
 def partition_uniform(num_examples: int, num_workers: int, seed: int = 1234) -> List[np.ndarray]:
@@ -31,6 +36,26 @@ def partition_uniform(num_examples: int, num_workers: int, seed: int = 1234) -> 
     order = rng.permutation(num_examples)
     per = num_examples // num_workers
     return [order[i * per : (i + 1) * per].astype(np.int64) for i in range(num_workers)]
+
+
+def partition_fractions(
+    num_examples: int, fractions: List[float], seed: int = 1234
+) -> List[np.ndarray]:
+    """Seeded shuffle split by arbitrary fractions — the reference
+    ``DataPartitioner(sizes=...)`` general form (util.py:46-59), which its
+    call sites only ever use uniformly.  Each part gets ``int(frac·n)``
+    examples, consumed in order (truncation semantics match ``int()`` at
+    util.py:55-58)."""
+    if any(f < 0 for f in fractions) or sum(fractions) > 1.0 + 1e-9:
+        raise ValueError(f"fractions must be >= 0 and sum to <= 1, got {fractions}")
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(num_examples)
+    parts, cursor = [], 0
+    for f in fractions:
+        take = int(f * num_examples)
+        parts.append(order[cursor : cursor + take].astype(np.int64))
+        cursor += take
+    return parts
 
 
 def partition_label_skew(

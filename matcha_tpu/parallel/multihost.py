@@ -1,0 +1,95 @@
+"""Multi-host (DCN) support for the worker mesh.
+
+The reference scales across hosts with ``mpirun -np N`` over
+sockets/InfiniBand (/root/reference/README.md:62-65, SURVEY.md §5.8).  The
+TPU-native equivalent is JAX multi-process SPMD: every host runs this same
+program, ``jax.distributed.initialize`` wires the PJRT coordination service,
+and the worker mesh simply spans ``jax.devices()`` (which is then global —
+all chips on all hosts).  Collectives ride ICI within a slice and DCN across
+slices; nothing in the gossip code changes, because the folded plan
+(``gossip.build_folded_plan``) already decomposes each matching by
+*chip offset*, and XLA routes each ``ppermute`` hop over whichever fabric
+connects the two chips.
+
+Placement note: the schedule is topology-aware but fabric-oblivious by
+default.  ``dcn_aware_worker_order`` reorders workers so that consecutive
+ranks land on the same host — matchings produced by ring/torus-style
+topologies then keep most edges intra-host (ICI) and only O(num_hosts)
+edges cross DCN, the same locality trick the MATCHA paper applies to
+rack-level oversubscription.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from .mesh import WORKER_AXIS, worker_mesh
+
+__all__ = ["initialize_multihost", "global_worker_mesh", "dcn_aware_worker_order"]
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Idempotent ``jax.distributed.initialize`` wrapper.
+
+    Returns False only in the two genuinely benign cases — already
+    initialized, or no multi-host configuration anywhere (no arguments and
+    no cluster environment): then the caller is a single-process program and
+    may proceed.  A *failed* initialization with explicit arguments or a
+    cluster environment present re-raises: each host silently falling back
+    to its local devices would train N divergent models instead of one.
+    """
+    import os
+
+    env_configured = any(
+        os.environ.get(k)
+        for k in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+    )
+    if coordinator_address is None and num_processes is None and not env_configured:
+        return False  # single-process: nothing to wire
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except RuntimeError as e:
+        if "already" in str(e).lower():  # initialize() called twice
+            return False
+        raise
+
+
+def global_worker_mesh(axis: str = WORKER_AXIS):
+    """1-D worker mesh over the *global* device set (all hosts).
+
+    A documentation alias for ``worker_mesh()`` — ``jax.devices()`` is
+    already global in a multi-process program — named so call sites state
+    their multi-host intent.
+    """
+    return worker_mesh(axis=axis)
+
+
+def dcn_aware_worker_order(
+    num_workers: int, devices: Optional[Sequence[jax.Device]] = None
+) -> np.ndarray:
+    """Permutation of worker ids grouping same-host workers consecutively.
+
+    Workers fold onto devices chip-major (``g = c·L + l``); sorting devices
+    by ``(process_index, id)`` means worker blocks align with hosts, so
+    locality-friendly topologies keep gossip edges on ICI.  Returns the
+    device order to pass to ``worker_mesh(devices=...)``.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    order = sorted(range(len(devs)), key=lambda i: (devs[i].process_index, devs[i].id))
+    if num_workers % len(devs):
+        raise ValueError(
+            f"num_workers={num_workers} must be divisible by {len(devs)} devices"
+        )
+    return np.asarray([devs[i] for i in order], dtype=object)

@@ -2,6 +2,7 @@
 compile-time contract (perms, alpha, probs, flags) consumed by device code."""
 
 from .base import Schedule, sample_flags
+from .faults import effective_activation_probs, with_link_failures
 from .fixed import fixed_schedule
 from .matcha import matcha_schedule
 from .solvers import (
@@ -13,7 +14,9 @@ from .solvers import (
 
 __all__ = [
     "Schedule",
+    "effective_activation_probs",
     "sample_flags",
+    "with_link_failures",
     "fixed_schedule",
     "matcha_schedule",
     "contraction_rho",

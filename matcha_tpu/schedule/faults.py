@@ -1,0 +1,57 @@
+"""Fault injection for gossip schedules.
+
+The reference has **no** failure handling or fault-injection hooks
+(SURVEY.md §5.3): a dead rank hangs its blocking ``sendrecv``/``barrier``
+forever.  The TPU design is one SPMD program, so a mid-step chip failure is
+the runtime's problem (checkpoint/restore, §5.4) — but *link-level* faults
+(a gossip round silently not happening) are a schedule property, and because
+the schedule is a precomputed flag array they can be injected deterministically
+ahead of time and studied without any runtime machinery:
+
+``with_link_failures``
+    Drop each *active* matching independently per step with probability
+    ``drop_prob`` — a transient link outage taking that round's pairwise
+    exchanges down.  Consensus theory says gossip tolerates this: the
+    effective activation probability becomes ``p_j·(1−drop_prob)``, so the
+    expected mixing still contracts (at a slower rate) as long as the
+    expected graph stays connected; ``effective_activation_probs`` feeds the
+    degraded probabilities back into the α solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .base import Schedule
+
+__all__ = ["with_link_failures", "effective_activation_probs"]
+
+
+def with_link_failures(
+    schedule: Schedule, drop_prob: float, seed: int = 0
+) -> Schedule:
+    """Return a schedule whose active flags are thinned by i.i.d. link drops.
+
+    Each (step, matching) flag that is 1 survives with probability
+    ``1 − drop_prob``.  Deterministic under ``seed``; the original schedule
+    is unchanged (schedules are frozen).
+    """
+    if not 0.0 <= drop_prob <= 1.0:
+        raise ValueError(f"drop_prob must be in [0,1], got {drop_prob}")
+    rng = np.random.default_rng(seed)
+    survives = rng.random(schedule.flags.shape) >= drop_prob
+    flags = (schedule.flags.astype(bool) & survives).astype(np.uint8)
+    return dataclasses.replace(
+        schedule, flags=flags, name=f"{schedule.name}+drop{drop_prob}"
+    )
+
+
+def effective_activation_probs(schedule: Schedule, drop_prob: float) -> np.ndarray:
+    """Expected per-matching activation under link failures: ``p_j·(1−drop)``.
+
+    Feed this back into ``solve_mixing_weight`` to re-derive an α that is
+    optimal for the degraded link reliability (the reference cannot do this —
+    its α is frozen at construction, graph_manager.py:268-296)."""
+    return np.asarray(schedule.probs) * (1.0 - drop_prob)

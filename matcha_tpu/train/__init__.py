@@ -3,7 +3,7 @@ recorder, checkpointing."""
 
 from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from .config import TrainConfig
-from .loop import TrainResult, build_dataset, build_schedule, train
+from .loop import TrainingDiverged, TrainResult, build_dataset, build_schedule, train
 from .lr import make_lr_schedule
 from .recorder import Recorder
 from .state import (
@@ -18,6 +18,7 @@ __all__ = [
     "Recorder",
     "TrainConfig",
     "TrainResult",
+    "TrainingDiverged",
     "TrainState",
     "build_dataset",
     "build_schedule",

@@ -55,7 +55,7 @@ class TrainConfig:
     communicator: str = "decen"  # decen|choco|centralized|none
     compress_ratio: float = 0.9
     consensus_lr: float = 0.1
-    gossip_backend: str = "auto"  # gather|shard_map|auto
+    gossip_backend: str = "auto"  # fused|dense|gather|shard_map|auto
 
     # logging / checkpointing (reference: --save/--savePath; ckpt is new — §5.4)
     save: bool = False
@@ -68,6 +68,7 @@ class TrainConfig:
     scan_epoch: bool = True  # lax.scan over an epoch's batches (one program)
     devices: Optional[int] = None  # mesh size; None → all available
     measure_comm_split: bool = True  # two-program comp/comm timing (§5.1)
+    halt_on_divergence: bool = True  # raise TrainingDiverged on NaN loss (§5.3)
 
     def __post_init__(self):
         if self.communicator not in ("decen", "choco", "centralized", "none"):

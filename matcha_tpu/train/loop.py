@@ -44,7 +44,19 @@ from .lr import make_lr_schedule
 from .recorder import Recorder
 from .state import TrainState, init_train_state, make_eval_fn, make_optimizer, make_train_step
 
-__all__ = ["build_schedule", "build_dataset", "train", "TrainResult"]
+__all__ = ["build_schedule", "build_dataset", "train", "TrainResult",
+           "TrainingDiverged"]
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised when an epoch produces a non-finite loss or parameters.
+
+    The reference has no failure detection at all (SURVEY.md §5.3) — a NaN
+    would silently propagate through gossip to every replica and surface as
+    garbage accuracy many epochs later.  Detecting it at the epoch boundary
+    costs two reductions and names the epoch it happened in; the recorder is
+    flushed first so the loss curve leading into the blow-up survives on
+    disk."""
 
 
 def build_schedule(config: TrainConfig, iterations: int) -> Schedule:
@@ -190,6 +202,28 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         jax.block_until_ready(state.params)
         epoch_time = time.time() - t0
 
+        if config.halt_on_divergence:
+            loss_bad = not np.isfinite(epoch_metrics["loss"])
+            params_bad = not loss_bad and not bool(_all_finite(state.params))
+            if loss_bad or params_bad:
+                what = ("training loss " + str(epoch_metrics["loss"])) if loss_bad \
+                    else "parameters"
+                # preserve the curve leading into the blow-up (flush beats the
+                # every-10-epochs cadence, which would drop up to 9 epochs)
+                recorder.add_epoch(
+                    epoch_time=epoch_time, comp_time=epoch_time, comm_time=0.0,
+                    train_acc=epoch_metrics["accuracy"],
+                    train_loss=epoch_metrics["loss"],
+                    test_acc=np.zeros(config.num_workers),
+                    disagreement=epoch_metrics["disagreement"],
+                )
+                if config.save:
+                    recorder.save()
+                raise TrainingDiverged(
+                    f"non-finite {what} in epoch {epoch} "
+                    f"(lr={config.lr}, communicator={config.communicator})"
+                )
+
         comm_time = 0.0
         if comm_timer is not None:
             window = schedule.flags[epoch * bpe : (epoch + 1) * bpe]
@@ -228,6 +262,12 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if config.save:
         recorder.save()
     return TrainResult(state, recorder, schedule, history)
+
+
+@jax.jit
+def _all_finite(tree) -> jax.Array:
+    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree_util.tree_leaves(tree)]
+    return jnp.all(jnp.stack(leaves))
 
 
 def _make_comm_timer(communicator, flattener, sample_steps: int = 32):

@@ -1,5 +1,6 @@
-"""Shared utilities: metrics, timing."""
+"""Shared utilities: metrics, timing, profiling."""
 
 from .metrics import AverageMeter, cross_entropy_loss, top_k_accuracy
+from .profiling import annotate, trace
 
-__all__ = ["AverageMeter", "cross_entropy_loss", "top_k_accuracy"]
+__all__ = ["AverageMeter", "annotate", "cross_entropy_loss", "top_k_accuracy", "trace"]

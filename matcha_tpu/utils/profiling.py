@@ -1,0 +1,51 @@
+"""Profiler integration (SURVEY.md §5.1).
+
+The reference's only telemetry is ``time.time()`` brackets around the MPI
+calls (train_mpi.py:114-143).  Under XLA that boundary does not exist — the
+gossip is fused into the train step — so the framework offers two layers:
+
+* the *two-program split* in the train loop (``comp_time``/``comm_time``
+  series, reference-compatible CSVs), and
+* real ``jax.profiler`` traces for kernel-level attribution, via
+  :func:`trace` — view in TensorBoard or Perfetto to see the Pallas gossip
+  kernel, the per-matching permutes, and the model's fwd/bwd separately.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+import jax
+
+__all__ = ["trace", "annotate"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Context manager capturing a ``jax.profiler`` trace into ``log_dir``.
+
+    Usage::
+
+        with profiling.trace("/tmp/tb"):
+            state, metrics = step(state, xb, yb)
+            jax.block_until_ready(state.params)
+
+    The block must end with a ``block_until_ready`` (or any host readback),
+    otherwise asynchronously-dispatched work lands outside the trace.
+    """
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span for the profiler timeline (``jax.profiler.TraceAnnotation``).
+
+    Wrap host-side phases (data staging, checkpointing, the comm-split
+    timer) so they are attributable in the trace alongside device work.
+    """
+    return jax.profiler.TraceAnnotation(name)

@@ -118,3 +118,18 @@ def test_worker_batches_rejects_oversized_batch():
     parts = partition_uniform(64, 8)
     with pytest.raises(ValueError):
         WorkerBatches(ds.x_train, ds.y_train, parts, batch_size=16)
+
+
+def test_partition_fractions_reference_semantics():
+    from matcha_tpu.data import partition_fractions
+
+    parts = partition_fractions(103, [0.5, 0.3, 0.2], seed=7)
+    # int() truncation semantics (util.py:55-58)
+    assert [len(p) for p in parts] == [51, 30, 20]
+    allidx = np.concatenate(parts)
+    assert len(np.unique(allidx)) == len(allidx)  # disjoint
+    # deterministic under seed
+    again = partition_fractions(103, [0.5, 0.3, 0.2], seed=7)
+    assert all(np.array_equal(a, b) for a, b in zip(parts, again))
+    with pytest.raises(ValueError):
+        partition_fractions(10, [0.8, 0.4])

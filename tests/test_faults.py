@@ -1,0 +1,96 @@
+"""Fault injection + failure detection (SURVEY.md §5.3 — a gap the
+reference leaves entirely open)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+from matcha_tpu.schedule import (
+    effective_activation_probs,
+    matcha_schedule,
+    with_link_failures,
+)
+from matcha_tpu.train import TrainConfig, TrainingDiverged, train
+
+
+def _sched(iterations=4000):
+    dec = tp.decompose(tp.ring_graph(8), 8, seed=0)
+    return matcha_schedule(dec, 8, iterations, budget=0.75, seed=0)
+
+
+def test_link_failures_thin_flags_deterministically():
+    s = _sched()
+    dropped = with_link_failures(s, 0.3, seed=1)
+    assert dropped.flags.shape == s.flags.shape
+    # only ever turns flags off, never on
+    assert not np.any(dropped.flags & ~s.flags)
+    # deterministic
+    again = with_link_failures(s, 0.3, seed=1)
+    assert np.array_equal(dropped.flags, again.flags)
+    assert not np.array_equal(
+        dropped.flags, with_link_failures(s, 0.3, seed=2).flags
+    )
+    # survival rate ~ 1 - drop_prob among originally-active slots
+    active = s.flags.astype(bool)
+    survival = dropped.flags[active].mean()
+    assert abs(survival - 0.7) < 0.03
+    # immutable input
+    assert s.flags[active].all()
+
+
+def test_link_failures_edge_cases():
+    s = _sched(iterations=50)
+    assert np.array_equal(with_link_failures(s, 0.0).flags, s.flags)
+    assert with_link_failures(s, 1.0).flags.sum() == 0
+    with pytest.raises(ValueError):
+        with_link_failures(s, 1.5)
+
+
+def test_effective_probs_feed_alpha_solver():
+    from matcha_tpu.schedule import solve_mixing_weight
+
+    s = _sched(iterations=10)
+    p_eff = effective_activation_probs(s, 0.4)
+    np.testing.assert_allclose(p_eff, np.asarray(s.probs) * 0.6)
+    alpha, rho = solve_mixing_weight(s.laplacians(), p_eff)
+    assert alpha > 0 and rho < 1.0  # ring stays connected in expectation
+
+
+def test_consensus_still_contracts_under_link_failures():
+    # gossip over a 30%-lossy schedule must still drive replicas together
+    import jax.numpy as jnp
+
+    from matcha_tpu.communicator import make_decen
+
+    s = with_link_failures(_sched(iterations=200), 0.3, seed=5)
+    comm = make_decen(s, backend="dense")
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 64)), jnp.float32)
+    out, _ = comm.run(x, s.flags)
+    spread0 = float(np.ptp(np.asarray(x), axis=0).max())
+    spread1 = float(np.ptp(np.asarray(out), axis=0).max())
+    assert spread1 < 0.05 * spread0  # strong contraction despite drops
+    # and the mean is preserved (gossip is mean-invariant)
+    np.testing.assert_allclose(
+        np.asarray(out).mean(0), np.asarray(x).mean(0), atol=1e-4
+    )
+
+
+def test_divergence_detection_raises(tmp_path):
+    # lr large enough to blow up the MLP on synthetic data within 2 epochs
+    cfg = TrainConfig(
+        name="boom", model="mlp", dataset="synthetic", num_workers=8,
+        graphid=5, batch_size=16, epochs=2, lr=1e4, warmup=False,
+        seed=0, measure_comm_split=False, save=True, savePath=str(tmp_path),
+    )
+    with pytest.raises(TrainingDiverged, match="epoch"):
+        train(cfg)
+    # the recorder was flushed on the way out: the curve into the blow-up
+    # survives on disk even though the every-10-epochs cadence never fired
+    logs = list((tmp_path / "boom_mlp").glob("*-losses.log"))
+    assert logs and logs[0].read_text().strip()
+    # and the off switch keeps the old silent behavior
+    cfg_off = dataclasses.replace(cfg, halt_on_divergence=False, epochs=1,
+                                  save=False)
+    train(cfg_off)  # completes without raising

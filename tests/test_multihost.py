@@ -1,0 +1,35 @@
+"""Multi-host helpers (single-process semantics; the multi-process paths are
+the same code — jax.devices() is global there)."""
+
+import numpy as np
+
+from matcha_tpu.parallel import (
+    dcn_aware_worker_order,
+    global_worker_mesh,
+    initialize_multihost,
+)
+
+
+def test_initialize_multihost_is_safe_single_process():
+    # single-process / already-initialized: returns False, never raises
+    assert initialize_multihost() is False
+
+
+def test_global_worker_mesh_spans_all_devices():
+    import jax
+
+    mesh = global_worker_mesh()
+    assert mesh.size == len(jax.devices())
+
+
+def test_dcn_aware_worker_order():
+    import jax
+    import pytest
+
+    devs = dcn_aware_worker_order(16)
+    assert len(devs) == len(jax.devices())
+    # sorted by (process_index, id): stable and deterministic
+    keys = [(d.process_index, d.id) for d in devs]
+    assert keys == sorted(keys)
+    with pytest.raises(ValueError):
+        dcn_aware_worker_order(len(jax.devices()) * 2 + 1)

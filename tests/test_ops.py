@@ -96,3 +96,18 @@ def test_select_compressor():
     assert select_compressor("top_k") is batched_top_k
     with pytest.raises(KeyError):
         select_compressor("zip")
+
+
+def test_profiler_trace_writes_events(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu.utils import annotate, trace
+
+    with trace(str(tmp_path)):
+        with annotate("tiny-matmul"):
+            out = jax.jit(lambda a: a @ a)(jnp.ones((8, 8)))
+            jax.block_until_ready(out)
+    # the profiler lays out <dir>/plugins/profile/<run>/*.xplane.pb
+    produced = list(tmp_path.rglob("*.xplane.pb"))
+    assert produced, f"no trace files under {tmp_path}"

@@ -66,7 +66,8 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--consensus-lr", type=float, default=0.1, dest="consensus_lr")
     p.add_argument("--centralized", action="store_true", help="AllReduce baseline")
     p.add_argument("--randomSeed", type=int, default=9001, dest="seed")
-    p.add_argument("--backend", default="auto", help="gossip backend: dense|gather|shard_map|auto")
+    p.add_argument("--backend", default="auto",
+                   help="gossip backend: fused|dense|gather|shard_map|auto")
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", default=None, help="checkpoint dir to resume from")
     p.add_argument("--eval-every", type=int, default=1)
