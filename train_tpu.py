@@ -68,6 +68,11 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--randomSeed", type=int, default=9001, dest="seed")
     p.add_argument("--backend", default="auto",
                    help="gossip backend: fused|dense|gather|shard_map|auto")
+    p.add_argument("--fixed-mode", default="all", dest="fixed_mode",
+                   help="D-PSGD flag mode: all|bernoulli|alternating "
+                        "(alternating = reference ring parity, SURVEY Q1)")
+    p.add_argument("--no-comm-split", action="store_true",
+                   help="skip the per-epoch two-program comp/comm timing")
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", default=None, help="checkpoint dir to resume from")
     p.add_argument("--eval-every", type=int, default=1)
