@@ -5,10 +5,22 @@ Measures the MATCHA hot path of BASELINE.json's north star — 256 virtual
 workers, ResNet-20-sized flat parameter state, MATCHA schedule at budget 0.5 —
 and prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": "gossip_steps_per_sec", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "gossip_steps_per_sec",
+     "vs_baseline": N, "achieved_tflops": ..., "mfu": ...,
+     "bytes_per_step": ..., "achieved_gbps": ...}
 
 ``vs_baseline`` is value / 5000 (the ≥5k steps/sec north-star target; the
-reference publishes no numbers of its own — BASELINE.md).
+reference publishes no numbers of its own — BASELINE.md).  The roofline
+fields report the fused kernel's position against the chip's peak MXU
+throughput and HBM bandwidth, so the number is judged against hardware.
+
+Robustness (round-1 postmortem): the TPU backend in this environment can hang
+for minutes inside ``jax.devices()`` or die with ``UNAVAILABLE`` at init
+(BENCH_r01.json rc=1).  The measurement therefore runs in a *worker
+subprocess* under a bounded wall-clock budget; the parent retries on
+timeout/crash and, if the TPU never comes up, records a structured JSON line
+with an ``error`` field (plus a CPU-measured fallback value) — never a raw
+traceback, never rc!=0.
 
 Flags:
   --smoke        tiny sizes for a CPU sanity run
@@ -18,16 +30,42 @@ Flags:
   --dtype D      bf16|f32                     (default bf16)
   --steps N      scan length per timing rep
   --workers N    virtual workers (default 256)
+  --attempt-timeout S / --retries K   bound each worker attempt
+  --in-process   skip the subprocess shield (debugging)
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+NORTH_STAR = 5000.0
+
+# bf16 peak matmul TFLOP/s and HBM GB/s per chip, by device_kind substring.
+# Public figures (cloud.google.com/tpu/docs/system-architecture-tpu-vm).
+_CHIP_PEAKS = {
+    "v6": (918.0, 1640.0),
+    "v5p": (459.0, 2765.0),
+    "v5e": (197.0, 819.0),
+    "v5lite": (197.0, 819.0),
+    "v4": (275.0, 1228.0),
+    "v3": (123.0, 900.0),
+    "v2": (45.0, 700.0),
+}
+
+
+def _chip_peaks(device_kind: str):
+    kind = device_kind.lower().replace(" ", "")
+    for key, peaks in _CHIP_PEAKS.items():
+        if key in kind:
+            return peaks
+    return None, None
 
 
 def build(args):
@@ -87,19 +125,41 @@ def time_backend(backend, sched, x, steps, dtype):
     return steps / best
 
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--smoke", action="store_true")
-    p.add_argument("--backend", default="fused",
-                   help="fused|dense|gather|shard_map|all; gather runs ~18 "
-                        "steps/s — pair it with --steps 200 or it takes minutes")
-    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
-    # long chain amortizes the fixed ~70ms launch/dispatch overhead of the
-    # tunneled backend; the fused kernel's marginal rate is ~5k steps/s
-    p.add_argument("--steps", type=int, default=5000)
-    p.add_argument("--workers", type=int, default=256)
-    args = p.parse_args()
+def roofline(backend, value, n, dim, dtype, block_d=2048):
+    """Per-step FLOP and HBM-byte model for the MXU backends, evaluated at
+    the measured rate.  The fused kernel's traffic model is derived in
+    matcha_tpu/parallel/pallas_gossip.py:1-23: per chain of T steps the state
+    moves once (2·N·D) and the W_t stack streams per D-block
+    ((D/block_d)·T·N²); per step that amortizes to 2·N·D/T + ceil(D/bd)·N².
+    The dense backend re-materializes the state every step (2·N·D + N²)."""
+    import jax
 
+    bytes_el = 2 if dtype == "bf16" else 4
+    flops_per_step = 2.0 * n * n * dim
+    d_blocks = -(-dim // block_d)
+    if backend == "fused":
+        bytes_per_step = d_blocks * n * n * bytes_el  # + 2·N·D/T ≈ 0 at T≫1
+    else:
+        bytes_per_step = (2.0 * n * dim + n * n) * bytes_el
+    achieved_tflops = flops_per_step * value / 1e12
+    achieved_gbps = bytes_per_step * value / 1e9
+    kind = jax.devices()[0].device_kind
+    peak_tflops, peak_gbps = _chip_peaks(kind)
+    out = {
+        "device_kind": kind,
+        "flops_per_step": flops_per_step,
+        "bytes_per_step": bytes_per_step,
+        "achieved_tflops": round(achieved_tflops, 2),
+        "achieved_gbps": round(achieved_gbps, 2),
+    }
+    if peak_tflops:
+        out["mfu"] = round(achieved_tflops / peak_tflops, 4)
+        out["hbm_frac"] = round(achieved_gbps / peak_gbps, 4)
+    return out
+
+
+def worker_main(args) -> int:
+    """The actual measurement; prints the final JSON line on stdout."""
     sched, x, steps, dim = build(args)
 
     # ("all" skips gather: at ~18 steps/s it would take minutes per rep;
@@ -110,15 +170,127 @@ def main():
         if len(backends) > 1:
             print(f"# {b}: {v:.1f} steps/s", file=sys.stderr)
 
-    value = max(results.values())
-    print(json.dumps({
-        "metric": f"gossip-steps/sec @ {x.shape[0]} virtual workers, "
+    best_backend = max(results, key=results.get)
+    value = results[best_backend]
+    n = x.shape[0]
+    record = {
+        "metric": f"gossip-steps/sec @ {n} virtual workers, "
                   f"D={dim} (ResNet-20), MATCHA budget 0.5, {args.dtype}",
         "value": round(value, 1),
         "unit": "gossip_steps_per_sec",
-        "vs_baseline": round(value / 5000.0, 4),
-    }))
+        "vs_baseline": round(value / NORTH_STAR, 4),
+        "backend": best_backend,
+    }
+    if best_backend in ("fused", "dense"):
+        record.update(roofline(best_backend, value, n, dim, args.dtype))
+    print(json.dumps(record))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Parent-side orchestration: bounded attempts, structured output on failure
+# ---------------------------------------------------------------------------
+
+def _last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_bounded(cmd, env, timeout):
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        return proc.returncode, proc.stdout, proc.stderr, False, time.time() - t0
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        err = e.stderr or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        return -1, out, err, True, time.time() - t0
+
+
+def orchestrate(args, passthrough) -> int:
+    me = os.path.abspath(__file__)
+    cmd = [sys.executable, me, "--in-process"] + passthrough
+    attempts = []
+    for i in range(args.retries):
+        rc, out, err, timed_out, secs = _run_bounded(cmd, dict(os.environ), args.attempt_timeout)
+        record = _last_json_line(out)
+        if rc == 0 and record is not None:
+            if attempts:
+                record["retries"] = attempts
+            print(json.dumps(record))
+            return 0
+        attempts.append({
+            "attempt": i + 1, "rc": rc, "timed_out": timed_out,
+            "seconds": round(secs, 1),
+            "stderr_tail": err.strip()[-300:],
+        })
+        print(f"# attempt {i+1} failed (rc={rc}, timeout={timed_out})", file=sys.stderr)
+
+    # The TPU never produced a number.  Record a CPU-measured fallback at a
+    # reduced step count so the round still has a structured, honest value
+    # (clearly labeled), rather than rc=1 and a traceback.
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cpu_cmd = [sys.executable, me, "--in-process", "--backend", "dense",
+               "--dtype", "f32", "--steps", "30", "--workers", str(args.workers)]
+    rc, out, err, timed_out, secs = _run_bounded(cpu_cmd, env, args.attempt_timeout)
+    record = _last_json_line(out) if rc == 0 else None
+    if record is None:
+        record = {
+            "metric": "gossip-steps/sec @ 256 virtual workers, D=ResNet-20, "
+                      "MATCHA budget 0.5",
+            "value": 0.0, "unit": "gossip_steps_per_sec", "vs_baseline": 0.0,
+        }
+    record["error"] = "tpu_backend_unavailable"
+    record["backend"] = "cpu-fallback"
+    record["tpu_attempts"] = attempts
+    print(json.dumps(record))
+    return 0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--backend", default="fused",
+                   help="fused|dense|gather|shard_map|all; gather runs ~18 "
+                        "steps/s — pair it with --steps 200 or it takes minutes")
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    # long chain amortizes the fixed ~70ms launch/dispatch overhead of the
+    # tunneled backend; the fused kernel's marginal rate is the headline
+    p.add_argument("--steps", type=int, default=5000)
+    p.add_argument("--workers", type=int, default=256)
+    p.add_argument("--attempt-timeout", type=float, default=900.0,
+                   help="wall-clock bound per measurement attempt (seconds)")
+    p.add_argument("--retries", type=int, default=2,
+                   help="TPU measurement attempts before the CPU fallback")
+    p.add_argument("--in-process", action="store_true",
+                   help="run the measurement in this process (no subprocess "
+                        "shield); used internally for the worker")
+    args, _ = p.parse_known_args()
+
+    if args.in_process:
+        return worker_main(args)
+
+    # reconstruct the flags the worker needs (everything except the shield's)
+    passthrough = []
+    if args.smoke:
+        passthrough.append("--smoke")
+    passthrough += ["--backend", args.backend, "--dtype", args.dtype,
+                    "--steps", str(args.steps), "--workers", str(args.workers)]
+    return orchestrate(args, passthrough)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

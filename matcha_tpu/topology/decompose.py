@@ -28,6 +28,7 @@ Two strategies:
 
 from __future__ import annotations
 
+import logging
 from typing import List, Sequence
 
 import numpy as np
@@ -41,6 +42,19 @@ __all__ = [
     "matchings_to_perms",
     "perms_to_neighbors",
 ]
+
+_logger = logging.getLogger(__name__)
+
+
+def _log_native_fallback(method: str, err: Exception) -> None:
+    """The native decomposer failed mid-call; we fall back to the Python
+    greedy pass.  Logged loudly because the fallback can change the
+    decomposition (and hence the schedule) for the same seed across
+    environments — runs comparing results should pin ``method=`` explicitly."""
+    _logger.warning(
+        "native %s decomposer failed (%s); falling back to Python greedy — "
+        "decomposition may differ from native-enabled environments", method, err
+    )
 
 
 def _dedup(edges: Sequence[Edge]) -> List[Edge]:
@@ -144,7 +158,11 @@ def decompose(
     if method == "color":
         from ..native import native_edge_color
 
-        result = native_edge_color(_dedup(edges), size)
+        try:
+            result = native_edge_color(_dedup(edges), size)
+        except RuntimeError as e:
+            result = None
+            _log_native_fallback("color", e)
         if result is None:
             return decompose_greedy(edges, size, seed)
         validate_decomposition(result, size, base_edges=_dedup(edges))
@@ -154,7 +172,11 @@ def decompose(
     if method == "greedy":
         from ..native import native_decompose_greedy
 
-        result = native_decompose_greedy(edges, size, seed)
+        try:
+            result = native_decompose_greedy(edges, size, seed)
+        except RuntimeError as e:
+            result = None
+            _log_native_fallback("greedy", e)
         if result is not None:
             validate_decomposition(result, size, base_edges=_dedup(edges))
             return result

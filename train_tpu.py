@@ -96,6 +96,8 @@ def parse_args(argv=None) -> TrainConfig:
         gossip_backend=args.backend, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         eval_every=args.eval_every,
+        fixed_mode=args.fixed_mode,
+        measure_comm_split=not args.no_comm_split,
     )
     return cfg
 
