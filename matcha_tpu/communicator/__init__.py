@@ -32,7 +32,11 @@ def select_communicator(
     if name == "decen":
         return make_decen(schedule, mesh=mesh, backend=backend)
     if name == "choco":
-        return make_choco(schedule, ratio=ratio, consensus_lr=consensus_lr)
+        # map the gossip backend vocabulary onto choco's two forms: the
+        # dense/fused/gather spellings are all the single-array batched path
+        choco_backend = backend if backend in ("auto", "shard_map") else "batched"
+        return make_choco(schedule, ratio=ratio, consensus_lr=consensus_lr,
+                          mesh=mesh, backend=choco_backend)
     if name == "centralized":
         return make_centralized()
     if name == "none":

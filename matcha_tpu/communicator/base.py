@@ -34,12 +34,20 @@ class Communicator:
     launch (e.g. the Pallas VMEM-resident gossip kernel) — arithmetically
     equivalent to scanning ``step``, used by ``run`` for consensus-only
     phases and the micro-benchmark.
+
+    ``encode_probe``, when present, is a scan-compatible stand-in for the
+    per-step message *encode* work (CHOCO's compress path) —
+    ``(flat, probe_state) -> probe_state`` with ``probe_state0 =
+    zeros_like(flat)``.  The comm-split timer uses it to report encode time
+    separately from exchange time, mirroring the reference's split timing of
+    compression vs sendrecv (communicator.py:184-196,268).
     """
 
     name: str
     init: Callable[[jax.Array], Any]
     step: StepFn
     multi_step: Any = None  # Optional[(flat, carry, flags[T,M]) -> (flat, carry)]
+    encode_probe: Any = None  # Optional[(flat, probe_state) -> probe_state]
 
     def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None):
         """Scan the communicator over a whole flag stream (consensus-only runs,

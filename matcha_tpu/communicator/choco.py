@@ -15,6 +15,23 @@ Persistent carry = {x̂, s} — zero-initialized like the reference's lazy init
 Skipped iterations (all flags 0) leave *all* state untouched, matching the
 reference's early return (communicator.py:249-250) — implemented by scaling
 every update by an ``any_active`` mask so the compiled program stays static.
+
+Backends
+--------
+``batched``
+    The ``[N, D]`` single-array form: neighbor messages are static row
+    gathers (``vals[π_j]``).  Any N under jit; the single-chip path.
+
+``shard_map``
+    Worker-sharded form for N virtual workers folded onto C chips.  Only the
+    *compressed* ``(vals, idx)`` blocks — ``[L, k]`` per chip, k ≪ D — ride
+    the ICI ``ppermute``s of the folded plan (one pair per matching × chip
+    offset), mirroring how the reference ships only the sparse
+    ``{values, indices}`` dict over the wire (communicator.py:214) rather
+    than the dense model.  The scatter-adds into the chip-local ``s``/``x̂``
+    blocks stay on-chip.  ``multi_step`` runs the whole flag stream as one
+    ``lax.scan`` *inside* a single shard_map call, so per-step dispatch and
+    re-entry costs are paid once per chain.
 """
 
 from __future__ import annotations
@@ -22,6 +39,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..ops import batched_top_k, scatter_rows
 from ..schedule import Schedule
@@ -30,46 +48,170 @@ from .base import Communicator
 __all__ = ["make_choco"]
 
 
+def _choco_core(vals, idx, x_hat, s, flat, flags_t, *, gather_msg, partnered_rows,
+                matching_nonempty, alpha, consensus_lr):
+    """Shared per-step CHOCO math given this block's top-k messages.
+
+    ``gather_msg(j) -> (vals[π_j], idx[π_j])`` abstracts the neighbor
+    exchange (row gather in the batched form; ppermute in the folded form).
+    ``partnered_rows``: ``f32[M, R]`` partner mask for the R rows held here
+    (may be traced); ``matching_nonempty``: static per-matching bools letting
+    globally-empty matchings drop out of the compiled program.
+    """
+    active = (jnp.sum(flags_t) > 0).astype(flat.dtype)  # 0 ⇒ frozen step
+    partnered_rows = jnp.asarray(partnered_rows)
+    for j in range(len(matching_nonempty)):
+        if not matching_nonempty[j]:
+            continue  # no edges anywhere: zero contribution, skip statically
+        g_vals, g_idx = gather_msg(j)
+        scale = active * flags_t[j] * alpha * partnered_rows[j]
+        s = scatter_rows(s, g_idx, g_vals, scale)
+
+    # self message with per-row weight 1 − d_i·α (d = active degree)
+    deg = partnered_rows.T @ flags_t  # [R]
+    s = scatter_rows(s, idx, vals, active * (1.0 - deg * alpha))
+    x_hat = scatter_rows(x_hat, idx, vals, active)
+    flat = flat + active * consensus_lr * (s - x_hat)
+    return flat, x_hat, s
+
+
 def make_choco(
     schedule: Schedule,
     ratio: float = 0.9,
     consensus_lr: float = 0.1,
+    mesh=None,
+    backend: str = "auto",
 ) -> Communicator:
     """Build the CHOCO communicator.
 
     ``ratio`` follows reference semantics: keep the top ``1−ratio`` fraction
     (0.9 ⇒ ~10%; hard-coded at the reference call site train_mpi.py:79 —
     here a real parameter).  ``consensus_lr`` is γ (default matches
-    train_mpi.py:228).
+    train_mpi.py:228).  ``backend``: ``batched`` | ``shard_map`` | ``auto``
+    (shard_map when a multi-device ``mesh`` is given).
     """
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
     M, N = perms.shape
     # partner masks: fixed points exchange nothing (communicator.py:210)
     partnered = (perms != np.arange(N)[None, :]).astype(np.float32)  # [M, N]
+    nonempty = [bool(partnered[j].any()) for j in range(M)]
+
+    if backend == "auto":
+        backend = "shard_map" if (mesh is not None and mesh.size > 1) else "batched"
 
     def init(flat: jax.Array):
         return {"x_hat": jnp.zeros_like(flat), "s": jnp.zeros_like(flat)}
 
+    def encode_probe(flat: jax.Array, x_hat: jax.Array) -> jax.Array:
+        """Per-step encode cost model for the comm-split timer: the compress
+        path (subtract + |·| top-k + gather), kept honestly state-evolving by
+        CHOCO's own ``x̂ += scatter(q)`` update so XLA cannot hoist it out of
+        the timing scan.  The extra [N,k] scatter is negligible next to the
+        [N,D] top-k — mirrors the reference's encode window
+        (communicator.py:184-196)."""
+        vals, idx = batched_top_k(flat - x_hat, ratio)
+        return scatter_rows(x_hat, idx, vals, 1.0)
+
+    if backend == "batched":
+
+        def step(flat: jax.Array, carry, flags_t: jax.Array):
+            vals, idx = batched_top_k(flat - carry["x_hat"], ratio)
+
+            def gather_msg(j):
+                pi = perms[j]
+                return vals[pi], idx[pi]
+
+            flat, x_hat, s = _choco_core(
+                vals, idx, carry["x_hat"], carry["s"], flat, flags_t,
+                gather_msg=gather_msg, partnered_rows=partnered,
+                matching_nonempty=nonempty,
+                alpha=alpha, consensus_lr=consensus_lr,
+            )
+            return flat, {"x_hat": x_hat, "s": s}
+
+        return Communicator(name=f"choco[r{ratio}]", init=init, step=step,
+                            encode_probe=encode_probe)
+
+    if backend != "shard_map":
+        raise KeyError(f"unknown choco backend '{backend}'")
+    if mesh is None:
+        raise ValueError("shard_map backend needs a mesh")
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import WORKER_AXIS, build_folded_plan
+
+    axis = WORKER_AXIS
+    C = mesh.shape[axis]
+    plan = build_folded_plan(perms, C)
+    L = plan.rows_per_chip
+    partnered_blocks = partnered.reshape(M, C, L)  # [M, C, L]
+
+    def chip_step(c, vals, idx, x_hat_blk, s_blk, flat_blk, flags_t):
+        """One CHOCO step for this chip's [L, D] block, given its top-k."""
+
+        def gather_msg(j):
+            # reconstruct (vals, idx)[π_j] for local rows: only the [L, k]
+            # compressed blocks move over ICI, never the dense state
+            g_vals = jnp.zeros_like(vals)
+            g_idx = jnp.zeros_like(idx)
+            for part in plan.matchings[j]:
+                if part.offset == 0:
+                    yv, yi = vals, idx
+                else:
+                    pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
+                    yv = lax.ppermute(vals, axis, pairs)
+                    yi = lax.ppermute(idx, axis, pairs)
+                src = jnp.asarray(part.src_local)[c]  # [L]
+                m = jnp.asarray(part.mask)[c]  # [L]
+                g_vals = g_vals + m[:, None] * yv[src]
+                g_idx = g_idx + m[:, None].astype(jnp.int32) * yi[src]
+            return g_vals, g_idx
+
+        partnered_rows = jnp.asarray(partnered_blocks)[:, c, :]  # [M, L]
+        return _choco_core(
+            vals, idx, x_hat_blk, s_blk, flat_blk, flags_t,
+            gather_msg=gather_msg, partnered_rows=partnered_rows,
+            matching_nonempty=nonempty,
+            alpha=alpha, consensus_lr=consensus_lr,
+        )
+
+    def body_one(flat_blk, x_hat_blk, s_blk, flags_t):
+        c = lax.axis_index(axis)
+        vals, idx = batched_top_k(flat_blk - x_hat_blk, ratio)
+        return chip_step(c, vals, idx, x_hat_blk, s_blk, flat_blk, flags_t)
+
+    def body_stream(flat_blk, x_hat_blk, s_blk, flags):
+        def scan_body(state, flags_t):
+            f, xh, s = state
+            return body_one(f, xh, s, flags_t), None
+
+        (f, xh, s), _ = lax.scan(scan_body, (flat_blk, x_hat_blk, s_blk), flags)
+        return f, xh, s
+
+    row = P(axis, None)
+
+    def _wrap(body, flags_spec):
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(row, row, row, flags_spec),
+            out_specs=(row, row, row),
+        )
+
+    sharded_one = _wrap(body_one, P())
+    sharded_stream = _wrap(body_stream, P())
+
     def step(flat: jax.Array, carry, flags_t: jax.Array):
-        x_hat, s = carry["x_hat"], carry["s"]
-        active = (jnp.sum(flags_t) > 0).astype(flat.dtype)  # 0 ⇒ frozen step
-
-        vals, idx = batched_top_k(flat - x_hat, ratio)  # [N, k] each
-
-        # neighbor messages: worker i receives (vals, idx)[π_j(i)] per active j
-        for j in range(M):
-            pi = perms[j]
-            if not partnered[j].any():
-                continue
-            scale = active * flags_t[j] * alpha * jnp.asarray(partnered[j])  # [N]
-            s = scatter_rows(s, idx[pi], vals[pi], scale)
-
-        # self message with per-worker weight 1 − d_i·α (d = active degree)
-        deg = jnp.asarray(partnered.T) @ flags_t  # [N]
-        s = scatter_rows(s, idx, vals, active * (1.0 - deg * alpha))
-        x_hat = scatter_rows(x_hat, idx, vals, active)
-        flat = flat + active * consensus_lr * (s - x_hat)
+        flat, x_hat, s = sharded_one(flat, carry["x_hat"], carry["s"], flags_t)
         return flat, {"x_hat": x_hat, "s": s}
 
-    return Communicator(name=f"choco[r{ratio}]", init=init, step=step)
+    def multi_step(flat: jax.Array, carry, flags: jax.Array):
+        flat, x_hat, s = sharded_stream(flat, carry["x_hat"], carry["s"], flags)
+        return flat, {"x_hat": x_hat, "s": s}
+
+    return Communicator(
+        name=f"choco[r{ratio},shard_map]", init=init, step=step,
+        multi_step=multi_step, encode_probe=encode_probe,
+    )

@@ -86,10 +86,12 @@ def synthetic_classification(
 
 
 def synthetic_images(
-    num_train: int = 2048, num_test: int = 512, seed: int = 0
+    num_train: int = 2048, num_test: int = 512, seed: int = 0,
+    separation: float = 4.0,
 ) -> Dataset:
     """CIFAR-shaped synthetic data ([32,32,3], 10 classes)."""
-    ds = synthetic_classification(num_train, num_test, (32, 32, 3), 10, seed)
+    ds = synthetic_classification(num_train, num_test, (32, 32, 3), 10, seed,
+                                  separation=separation)
     return dataclasses.replace(ds, name="synthetic_image")
 
 

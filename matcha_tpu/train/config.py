@@ -29,6 +29,9 @@ class TrainConfig:
     non_iid: bool = False
     augment: bool = False
     datasetRoot: Optional[str] = None  # .npz path for real datasets
+    # extra kwargs for the synthetic dataset builders (num_train, separation,
+    # ...) — lets benchmarks size/condition hermetic data without new flags
+    dataset_kwargs: Optional[dict] = None
 
     # optimization (reference: --lr/--momentum/--epoch/--warmup/--nesterov + wd=5e-4)
     lr: float = 0.8

