@@ -30,7 +30,7 @@ Flags:
   --dtype D      bf16|f32                     (default bf16)
   --steps N      scan length per timing rep
   --chunk S      chain-composition chunk for the fused backend (default 64;
-                 1 = per-step kernel only)
+                 1 = per-step kernel only; 0 = sweep {32,64,128}, keep best)
   --workers N    virtual workers (default 256)
   --attempt-timeout S / --retries K   bound each worker attempt
   --in-process   skip the subprocess shield (debugging)
@@ -178,6 +178,16 @@ def worker_main(args) -> int:
     # ("all" skips gather: at ~18 steps/s it would take minutes per rep;
     #  time it separately with --backend gather --steps 200)
     backends = ["fused", "dense"] if args.backend == "all" else [args.backend]
+    if args.chunk == 0 and "fused" in backends:
+        # auto: the optimal chunk balances apply-FLOP savings against the
+        # growing compose cost and varies by chip generation (v5e: 64)
+        sweep = {
+            c: time_backend("fused", sched, x, steps, args.dtype, chunk=c)
+            for c in (32, 64, 128)
+        }
+        args.chunk = max(sweep, key=sweep.get)
+        print(f"# auto chunk sweep: { {c: round(v, 1) for c, v in sweep.items()} } "
+              f"-> {args.chunk}", file=sys.stderr)
     results = {
         b: time_backend(b, sched, x, steps, args.dtype,
                         chunk=args.chunk if b == "fused" else 1)
@@ -299,7 +309,8 @@ def main():
                    help="chain-composition chunk for the fused backend: runs "
                         "of S mixing matrices are pre-multiplied (exact by "
                         "associativity) so each original step costs ~1/S of "
-                        "the apply FLOPs; 1 disables (TPU sweep: 64 optimal)")
+                        "the apply FLOPs; 1 disables, 0 sweeps {32,64,128} "
+                        "and keeps the best (v5e measured optimum: 64)")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=900.0,
                    help="wall-clock bound per measurement attempt (seconds)")

@@ -36,8 +36,8 @@ def resnet_config(depth: int) -> Tuple[str, Sequence[int]]:
     }
     if depth in reference:
         return reference[depth]
-    if depth >= 20 and (depth - 2) % 6 == 0:  # classic CIFAR ResNet-6n+2
-        n = (depth - 2) // 6
+    if depth >= 8 and (depth - 2) % 6 == 0:  # classic CIFAR ResNet-6n+2
+        n = (depth - 2) // 6  # n=1 gives ResNet-8, the smallest of the family
         return "basic", (n, n, n)
     raise ValueError(
         f"unsupported ResNet depth {depth}: need one of {sorted(reference)} or 6n+2"

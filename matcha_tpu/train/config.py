@@ -66,6 +66,9 @@ class TrainConfig:
     checkpoint_every: int = 0  # epochs; 0 = disabled
     resume: Optional[str] = None  # checkpoint dir to resume from
     eval_every: int = 1
+    # test-set eval slice per compiled call, per worker; 0 = auto-size so the
+    # vmapped (workers × batch) forward stays within HBM for big models
+    eval_batch: int = 0
 
     # execution
     scan_epoch: bool = True  # lax.scan over an epoch's batches (one program)

@@ -233,11 +233,15 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             # encode is a component of comm_time, never exceeding it
             comm_encode_time = min(split["comm_encode_time"], comm_time)
 
-        # evaluation: every worker on the full test set (train_mpi.py:152)
+        # evaluation: every worker on the full test set (train_mpi.py:152).
+        # The whole [workers, batch] block runs as one vmapped forward, so
+        # the per-worker slice shrinks as workers grow or activation memory
+        # blows past HBM (16-worker WRN-28-10 at 512 OOMs a 16 GB chip).
         test_loss = test_acc = np.zeros(config.num_workers)
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
+            eval_batch = config.eval_batch or max(16, 1024 // config.num_workers)
             test_loss, test_acc = _evaluate_in_batches(
-                evaluate, state, dataset.x_test, dataset.y_test, batch=512
+                evaluate, state, dataset.x_test, dataset.y_test, batch=eval_batch
             )
 
         recorder.add_epoch(
