@@ -29,8 +29,8 @@ Flags:
                  per-step MXU path)
   --dtype D      bf16|f32                     (default bf16)
   --steps N      scan length per timing rep
-  --chunk S      chain-composition chunk for the fused backend (default 64;
-                 1 = per-step kernel only; 0 = sweep {32,64,128}, keep best)
+  --chunk S      chain-composition chunk for the fused backend (default 256;
+                 1 = per-step kernel only; 0 = sweep {128,256,512}, keep best)
   --workers N    virtual workers (default 256)
   --attempt-timeout S / --retries K   bound each worker attempt
   --in-process   skip the subprocess shield (debugging)
@@ -178,19 +178,26 @@ def worker_main(args) -> int:
     # ("all" skips gather: at ~18 steps/s it would take minutes per rep;
     #  time it separately with --backend gather --steps 200)
     backends = ["fused", "dense"] if args.backend == "all" else [args.backend]
+    if args.chunk > 1:
+        # compose_mixing_stack rounds up to a power of two; canonicalize so
+        # the reported chunk and roofline match what actually executes
+        args.chunk = 1 << (args.chunk - 1).bit_length()
+    fused_timed = None
     if args.chunk == 0 and "fused" in backends:
         # auto: the optimal chunk balances apply-FLOP savings against the
-        # growing compose cost and varies by chip generation (v5e: 64)
+        # growing compose cost and varies by chip generation (v5e: 256)
         sweep = {
             c: time_backend("fused", sched, x, steps, args.dtype, chunk=c)
-            for c in (32, 64, 128)
+            for c in (128, 256, 512)
         }
         args.chunk = max(sweep, key=sweep.get)
+        fused_timed = sweep[args.chunk]  # no need to re-measure the winner
         print(f"# auto chunk sweep: { {c: round(v, 1) for c, v in sweep.items()} } "
               f"-> {args.chunk}", file=sys.stderr)
     results = {
-        b: time_backend(b, sched, x, steps, args.dtype,
-                        chunk=args.chunk if b == "fused" else 1)
+        b: (fused_timed if b == "fused" and fused_timed is not None else
+            time_backend(b, sched, x, steps, args.dtype,
+                         chunk=args.chunk if b == "fused" else 1))
         for b in backends
     }
     for b, v in results.items():
@@ -305,12 +312,12 @@ def main():
     # long chain amortizes the fixed ~70ms launch/dispatch overhead of the
     # tunneled backend; the fused kernel's marginal rate is the headline
     p.add_argument("--steps", type=int, default=5000)
-    p.add_argument("--chunk", type=int, default=64,
+    p.add_argument("--chunk", type=int, default=256,
                    help="chain-composition chunk for the fused backend: runs "
                         "of S mixing matrices are pre-multiplied (exact by "
                         "associativity) so each original step costs ~1/S of "
-                        "the apply FLOPs; 1 disables, 0 sweeps {32,64,128} "
-                        "and keeps the best (v5e measured optimum: 64)")
+                        "the apply FLOPs; 1 disables, 0 sweeps {128,256,512} "
+                        "and keeps the best (v5e measured optimum: 256)")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=900.0,
                    help="wall-clock bound per measurement attempt (seconds)")

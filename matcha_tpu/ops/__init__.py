@@ -3,6 +3,8 @@
 from .compress import (
     batched_random_k,
     batched_top_k,
+    batched_top_k_q8,
+    quantize_stochastic,
     dense_from_sparse,
     scatter_rows,
     select_compressor,
@@ -14,6 +16,8 @@ __all__ = [
     "WorkerFlattener",
     "batched_random_k",
     "batched_top_k",
+    "batched_top_k_q8",
+    "quantize_stochastic",
     "dense_from_sparse",
     "make_flattener",
     "scatter_rows",

@@ -23,6 +23,8 @@ __all__ = [
     "top_k_ratio_size",
     "batched_top_k",
     "batched_random_k",
+    "batched_top_k_q8",
+    "quantize_stochastic",
     "scatter_rows",
     "dense_from_sparse",
     "select_compressor",
@@ -34,12 +36,16 @@ def top_k_ratio_size(dim: int, ratio: float) -> int:
     return max(1, int(dim * (1.0 - ratio)))
 
 
-def batched_top_k(x: jax.Array, ratio: float) -> Tuple[jax.Array, jax.Array]:
+def batched_top_k(
+    x: jax.Array, ratio: float, key: jax.Array | None = None
+) -> Tuple[jax.Array, jax.Array]:
     """Per-worker magnitude top-k of ``[N, D]`` → ``(values[N,k], indices[N,k])``.
 
     Values carry sign (the reference gathers original entries by index);
     indices are int32, unsorted (``torch.topk(sorted=False)`` parity is
-    irrelevant downstream — only the selected set matters).
+    irrelevant downstream — only the selected set matters).  ``key`` is
+    accepted and ignored so every registry compressor shares the
+    ``(x, ratio, key)`` signature (top_k is the only deterministic one).
     """
     k = top_k_ratio_size(x.shape[-1], ratio)
     _, idx = jax.lax.top_k(jnp.abs(x), k)
@@ -82,13 +88,49 @@ def dense_from_sparse(indices: jax.Array, values: jax.Array, dim: int) -> jax.Ar
     return scatter_rows(zeros, indices, values, 1.0)
 
 
+def quantize_stochastic(
+    x: jax.Array, bits: int, key: jax.Array
+) -> jax.Array:
+    """QSGD-style unbiased stochastic quantization (dequantized form).
+
+    Per row: scale by the row's max magnitude, round each entry to one of
+    ``2^bits − 1`` uniform levels with probability proportional to its
+    fractional part, restore sign and scale.  ``E[quantize(x)] = x``; the
+    wire payload would be ``bits`` per entry plus one scale per row.  This is
+    the quantization hook the reference reserves next to top-k
+    (communicator.py:186-187) — composable with the sparse compressors by
+    quantizing their ``values`` payload (``top_k_q8``).
+    """
+    levels = (1 << bits) - 1
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    y = jnp.abs(x) / safe * levels
+    low = jnp.floor(y)
+    frac = y - low
+    up = jax.random.bernoulli(key, frac).astype(x.dtype)
+    q = (low + up) / levels * scale
+    return jnp.sign(x) * q
+
+
+def batched_top_k_q8(
+    x: jax.Array, ratio: float, key: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """top-k selection with the kept values stochastically quantized to
+    8 bits — the composed compressor: ~(8/32)·(1−ratio) of the dense payload."""
+    vals, idx = batched_top_k(x, ratio)
+    return quantize_stochastic(vals, 8, key), idx
+
+
 _COMPRESSORS: dict[str, Callable] = {
     "top_k": batched_top_k,
     "random_k": batched_random_k,
+    "top_k_q8": batched_top_k_q8,
 }
 
 
 def select_compressor(name: str) -> Callable:
+    """Uniform registry: every compressor is ``(x, ratio, key) -> (vals, idx)``
+    (``key`` unused by the deterministic ``top_k``)."""
     if name not in _COMPRESSORS:
         raise KeyError(f"unknown compressor '{name}'; have {sorted(_COMPRESSORS)}")
     return _COMPRESSORS[name]

@@ -111,3 +111,33 @@ def test_profiler_trace_writes_events(tmp_path):
     # the profiler lays out <dir>/plugins/profile/<run>/*.xplane.pb
     produced = list(tmp_path.rglob("*.xplane.pb"))
     assert produced, f"no trace files under {tmp_path}"
+
+
+def test_quantize_stochastic_unbiased_and_bounded():
+    from matcha_tpu.ops import quantize_stochastic
+
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(4, 257)), jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(0), 400)
+    qs = jax.vmap(lambda k: quantize_stochastic(x, 4, k))(keys)
+    # unbiased: the average over draws recovers x
+    np.testing.assert_allclose(np.asarray(qs.mean(0)), np.asarray(x),
+                               atol=3e-2, rtol=0)
+    # each draw stays on the quantization grid within one level of x
+    scale = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+    assert float(jnp.abs(qs - x).max()) <= (scale / 15).max() + 1e-6
+    # zero rows stay exactly zero
+    z = quantize_stochastic(jnp.zeros((2, 8)), 8, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(z), 0.0)
+
+
+def test_top_k_q8_registry_and_selection():
+    from matcha_tpu.ops import batched_top_k_q8, select_compressor
+
+    assert select_compressor("top_k_q8") is batched_top_k_q8
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(3, 40)), jnp.float32)
+    vals, idx = batched_top_k_q8(x, ratio=0.8, key=jax.random.PRNGKey(2))
+    ref_vals, ref_idx = batched_top_k(x, ratio=0.8)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_idx))
+    # quantized payload stays within one 8-bit level of the selected values
+    scale = np.abs(np.asarray(ref_vals)).max(axis=-1, keepdims=True)
+    assert np.abs(np.asarray(vals) - np.asarray(ref_vals)).max() <= (scale / 255).max() + 1e-6
