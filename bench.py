@@ -99,7 +99,7 @@ def time_backend(backend, sched, x, steps, dtype, chunk=1):
     import jax
     import jax.numpy as jnp
 
-    from matcha_tpu.communicator import make_decen
+    from matcha_tpu.communicator import make_choco, make_decen
 
     compute_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.float32
     mesh = None
@@ -107,8 +107,12 @@ def time_backend(backend, sched, x, steps, dtype, chunk=1):
         from matcha_tpu.parallel import worker_mesh
 
         mesh = worker_mesh()  # all local devices; workers fold onto them
-    comm = make_decen(sched, backend=backend, mesh=mesh,
-                      compute_dtype=compute_dtype, chunk=chunk)
+    if backend == "choco":
+        # compressed gossip at the reference ratio (BASELINE config 4)
+        comm = make_choco(sched, ratio=0.9, consensus_lr=0.1)
+    else:
+        comm = make_decen(sched, backend=backend, mesh=mesh,
+                          compute_dtype=compute_dtype, chunk=chunk)
     flags = jnp.asarray(sched.flags, jnp.float32)
     if backend in ("dense", "fused"):
         x = x.astype(compute_dtype)  # state rides in the wire dtype end-to-end
@@ -306,8 +310,9 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--backend", default="fused",
-                   help="fused|dense|gather|shard_map|all; gather runs ~18 "
-                        "steps/s — pair it with --steps 200 or it takes minutes")
+                   help="fused|dense|gather|shard_map|choco|all; gather and "
+                        "choco run orders of magnitude slower per step — pair "
+                        "them with --steps 200 or a rep takes minutes")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     # long chain amortizes the fixed ~70ms launch/dispatch overhead of the
     # tunneled backend; the fused kernel's marginal rate is the headline
