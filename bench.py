@@ -287,11 +287,19 @@ def orchestrate(args, passthrough) -> int:
 
     # The TPU never produced a number.  Record a CPU-measured fallback at a
     # reduced step count so the round still has a structured, honest value
-    # (clearly labeled), rather than rc=1 and a traceback.
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    cpu_cmd = [sys.executable, me, "--in-process", "--backend", "dense",
+    # (clearly labeled), rather than rc=1 and a traceback.  --force-cpu goes
+    # through jax.config (not the JAX_PLATFORMS env var, which this
+    # container's sitecustomize overrides — the env-var route hangs exactly
+    # like the TPU attempt when the axon backend is down).
+    env = dict(os.environ)
+    cpu_cmd = [sys.executable, me, "--in-process", "--force-cpu",
+               "--backend", "dense",
                "--dtype", "f32", "--steps", "30", "--workers", str(args.workers)]
-    rc, out, err, timed_out, secs = _run_bounded(cpu_cmd, env, args.attempt_timeout)
+    if args.smoke:
+        cpu_cmd.append("--smoke")
+    # the CPU fallback needs room for a full-size model init + 30 dense steps
+    rc, out, err, timed_out, secs = _run_bounded(
+        cpu_cmd, env, max(args.attempt_timeout, 600.0))
     record = _last_json_line(out) if rc == 0 else None
     if record is None:
         record = {
@@ -331,9 +339,16 @@ def main():
     p.add_argument("--in-process", action="store_true",
                    help="run the measurement in this process (no subprocess "
                         "shield); used internally for the worker")
+    p.add_argument("--force-cpu", action="store_true",
+                   help="pin the worker to the CPU backend via jax.config "
+                        "before any backend init (the CPU-fallback path)")
     args, _ = p.parse_known_args()
 
     if args.in_process:
+        if args.force_cpu:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
         return worker_main(args)
 
     # reconstruct the flags the worker needs (everything except the shield's)

@@ -206,3 +206,27 @@ def test_gossip_contracts_disagreement():
     np.testing.assert_allclose(
         np.asarray(x).mean(0), np.asarray(xT).mean(0), rtol=1e-4, atol=1e-5
     )
+
+
+def test_dense_backend_feature_sharded_parity():
+    """The README/DESIGN scaling claim for the dense/fused path: with the
+    worker state sharded along the *feature* axis, the N×N mixing matmul is
+    chip-local (each chip mixes its own D-slice; zero collectives needed for
+    gossip itself).  Run the dense backend under jit with x sharded over 8
+    devices on axis 1 and require bit-parity with the unsharded result."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from matcha_tpu.communicator import make_decen
+
+    sched = matcha_schedule(tp.select_graph(0), 8, iterations=10, budget=0.5, seed=3)
+    x = jnp.asarray(random_state(8, 64, seed=11))
+    comm = make_decen(sched, backend="dense")
+    want, _ = jax.jit(comm.run)(x, sched.flags)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("features",))
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "features")))
+    got, _ = jax.jit(comm.run)(xs, sched.flags)
+    # partitioned compilation may re-associate fusions, so tight allclose
+    # rather than bitwise equality
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=1e-6, atol=1e-6)
