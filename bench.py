@@ -183,9 +183,11 @@ def worker_main(args) -> int:
     #  time it separately with --backend gather --steps 200)
     backends = ["fused", "dense"] if args.backend == "all" else [args.backend]
     if args.chunk > 1:
-        # compose_mixing_stack rounds up to a power of two; canonicalize so
-        # the reported chunk and roofline match what actually executes
-        args.chunk = 1 << (args.chunk - 1).bit_length()
+        # canonicalize to the power of two compose_mixing_stack executes so
+        # the reported chunk and roofline match the measured run
+        from matcha_tpu.parallel import canonical_chunk
+
+        args.chunk = canonical_chunk(args.chunk)
     fused_timed = None
     if args.chunk == 0 and "fused" in backends:
         # auto: the optimal chunk balances apply-FLOP savings against the

@@ -12,12 +12,18 @@ from .gossip import (
 )
 from .mesh import WORKER_AXIS, fold_dims, replicated, shard_workers, worker_mesh
 from .multihost import dcn_aware_worker_order, global_worker_mesh, initialize_multihost
-from .pallas_gossip import build_mixing_stack, compose_mixing_stack, fused_gossip_run
+from .pallas_gossip import (
+    build_mixing_stack,
+    canonical_chunk,
+    compose_mixing_stack,
+    fused_gossip_run,
+)
 
 __all__ = [
     "WORKER_AXIS",
     "FoldedPlan",
     "build_mixing_stack",
+    "canonical_chunk",
     "compose_mixing_stack",
     "dcn_aware_worker_order",
     "fused_gossip_run",

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-__all__ = ["build_mixing_stack", "compose_mixing_stack", "fused_gossip_run"]
+__all__ = ["build_mixing_stack", "canonical_chunk", "compose_mixing_stack", "fused_gossip_run"]
 
 
 def build_mixing_stack(
@@ -50,6 +50,13 @@ def build_mixing_stack(
     w = alpha * jnp.asarray(flags, jnp.float32)  # [T, M]
     stack = jnp.eye(n, dtype=jnp.float32)[None] - jnp.einsum("tm,mnk->tnk", w, L)
     return stack.astype(dtype)
+
+
+def canonical_chunk(chunk: int) -> int:
+    """The chunk size compose_mixing_stack actually executes: powers of two
+    (pairwise doubling); values ≤ 1 disable composition."""
+    chunk = int(chunk)
+    return chunk if chunk <= 1 else 1 << (chunk - 1).bit_length()
 
 
 @functools.partial(jax.jit, static_argnames=("chunk",))
@@ -75,11 +82,10 @@ def compose_mixing_stack(stack: jax.Array, chunk: int) -> jax.Array:
     training interleaves one gossip step per SGD step and keeps ``chunk=1``.
     """
     t_steps, n, _ = stack.shape
-    chunk = int(chunk)
-    if chunk <= 1:
+    chunk2 = canonical_chunk(chunk)  # power-of-two granularity
+    if chunk2 <= 1:
         return stack
-    levels = max(1, int(np.ceil(np.log2(chunk))))
-    chunk2 = 1 << levels  # power-of-two granularity
+    levels = chunk2.bit_length() - 1
     pad = (-t_steps) % chunk2
     w = stack.astype(jnp.float32)
     if pad:

@@ -318,12 +318,6 @@ def _make_comm_timer(communicator, flattener, sample_steps: int = 32):
         """Measured t(k), t(2k) → marginal-cost estimate of t(n)."""
         n = len(flags_window)
         k = min(sample_steps, max(n // 2, 1))
-        if n <= 2 * k:  # short epoch: just time the whole window
-            flags = jnp.asarray(flags_window, jnp.float32)
-            float(fn(state.params, state.comm_carry, flags))  # warm/compile
-            t0 = time.time()
-            float(fn(state.params, state.comm_carry, flags))
-            return time.time() - t0
 
         def timed(m: int) -> float:
             flags = jnp.asarray(flags_window[:m], jnp.float32)
@@ -332,6 +326,8 @@ def _make_comm_timer(communicator, flattener, sample_steps: int = 32):
             float(fn(state.params, state.comm_carry, flags))
             return time.time() - t0
 
+        if n <= 2 * k:  # short epoch: just time the whole window
+            return timed(n)
         t1, t2 = timed(k), timed(2 * k)
         marginal = max(t2 - t1, 0.0) / k
         return t2 + marginal * (n - 2 * k)
