@@ -76,6 +76,9 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", default=None, help="checkpoint dir to resume from")
     p.add_argument("--eval-every", type=int, default=1)
+    p.add_argument("--eval-batch", type=int, default=0,
+                   help="test-set slice per compiled eval call per worker; "
+                        "0 auto-sizes to keep workers x batch within HBM")
     args = p.parse_args(argv)
 
     if args.compress and args.centralized:
@@ -96,6 +99,7 @@ def parse_args(argv=None) -> TrainConfig:
         gossip_backend=args.backend, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         eval_every=args.eval_every,
+        eval_batch=args.eval_batch,
         fixed_mode=args.fixed_mode,
         measure_comm_split=not args.no_comm_split,
     )
