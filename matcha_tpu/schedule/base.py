@@ -136,3 +136,30 @@ class Schedule:
     def slice(self, start: int, stop: int) -> "Schedule":
         """A view of steps [start, stop) — used for epoch-chunked scans."""
         return dataclasses.replace(self, flags=self.flags[start:stop])
+
+    def extend(self, iterations: int, seed: int, sampler: str = "numpy") -> "Schedule":
+        """The same schedule lengthened to ``iterations`` total steps —
+        training longer than originally planned, without perturbing history.
+
+        The existing flag rows are kept verbatim; rows beyond the current
+        horizon are fresh i.i.d. Bernoulli(probs) draws (both samplers are
+        prefix-stable, so extending with the original seed reproduces the
+        original prefix bit-for-bit and simply continues the stream).  Exact
+        for MATCHA and the all/bernoulli fixed modes; the ``alternating``
+        parity mode has no Bernoulli tail, so extending it raises.
+        """
+        if iterations < self.iterations:
+            raise ValueError(
+                f"extend to {iterations} < current {self.iterations}; use slice()"
+            )
+        if self.name == "fixed-alternating":
+            raise ValueError(
+                "alternating-mode flags are a deterministic parity pattern, "
+                "not Bernoulli draws; rebuild with fixed_schedule(iterations=...)"
+            )
+        flags = sample_flags(self.probs, iterations, seed, sampler)
+        if not np.array_equal(flags[: self.iterations], self.flags):
+            # different seed/sampler than the original build: keep the lived
+            # history, use the fresh draws only beyond it
+            flags = np.concatenate([self.flags, flags[self.iterations:]])
+        return dataclasses.replace(self, flags=flags)

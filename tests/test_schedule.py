@@ -301,3 +301,26 @@ def test_schedule_slice():
     sl = s.slice(2, 6)
     assert sl.iterations == 4
     assert np.array_equal(sl.perms, s.perms)
+
+
+def test_schedule_extend():
+    """Training longer than planned: extend() keeps the lived history
+    bit-for-bit and appends fresh Bernoulli draws; same-seed extension
+    reproduces the original prefix exactly."""
+    dec = tp.select_graph(0)
+    s1 = matcha_schedule(dec, 8, iterations=40, budget=0.5, seed=11)
+    s2 = s1.extend(100, seed=11)
+    assert s2.iterations == 100
+    np.testing.assert_array_equal(s2.flags[:40], s1.flags)
+    # the tail follows the activation probabilities (loose 3-sigma check)
+    tail_rate = s2.flags[40:].mean(axis=0)
+    sigma = np.sqrt(s1.probs * (1 - s1.probs) / 60)
+    assert (np.abs(tail_rate - s1.probs) < 4 * sigma + 1e-9).all()
+    # a different seed still preserves the prefix (history is immutable)
+    s3 = s1.extend(60, seed=999)
+    np.testing.assert_array_equal(s3.flags[:40], s1.flags)
+    with pytest.raises(ValueError, match="use slice"):
+        s1.extend(10, seed=11)
+    alt = fixed_schedule(tp.select_graph(5), 8, iterations=6, mode="alternating")
+    with pytest.raises(ValueError, match="alternating"):
+        alt.extend(12, seed=0)
