@@ -89,12 +89,14 @@ def test_full_partition_and_augmented_batches(cifar_npz):
 
 
 def test_one_epoch_of_config1_on_real_shaped_npz(cifar_npz, tmp_path):
-    """BASELINE config 1 (D-PSGD, graphid 0, 8 workers, ResNet/CIFAR-10)
-    through the real-data path for one epoch.  The npz is sliced to 1k/256
-    examples and the ResNet shrunk to depth 8 (same 6n+2 family, same conv
-    stages) to keep the CPU run bounded: XLA's LLVM backend needs >10 min to
-    compile the vmapped ResNet-20 train step on CPU, and the point here is
-    the load_npz → normalize → augment → train path, not the model size."""
+    """BASELINE config 1's *data path* (D-PSGD, graphid 0, 8 workers,
+    CIFAR-10 npz) through one full epoch.  The npz is sliced to 1k/256
+    examples and the model is the MLP: what this test pins is the
+    load_npz → normalize → augment → partition → train plumbing on real-shaped
+    pixels, not the conv program (covered by tests/test_models.py and the
+    TPU-side harnesses) — XLA's single-core CPU LLVM backend needs 25 min to
+    compile even a vmapped ResNet-8 train step, which made the conv variant
+    of this test 80% of the whole suite's wall-clock."""
     with np.load(cifar_npz) as z:
         small = str(tmp_path / "cifar10_small.npz")
         np.savez(small, x_train=z["x_train"][:1024], y_train=z["y_train"][:1024],
@@ -103,7 +105,7 @@ def test_one_epoch_of_config1_on_real_shaped_npz(cifar_npz, tmp_path):
     from matcha_tpu.train import TrainConfig, train
 
     cfg = TrainConfig(
-        name="realdata-config1", model="resnet8", dataset="cifar10",
+        name="realdata-config1", model="mlp", dataset="cifar10",
         datasetRoot=small, augment=True, batch_size=32, num_workers=8,
         graphid=0, matcha=False, fixed_mode="all", lr=0.1, warmup=False,
         epochs=1, save=False, eval_every=1, measure_comm_split=False,
