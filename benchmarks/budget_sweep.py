@@ -28,25 +28,17 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from matcha_tpu.train import TrainConfig, train  # noqa: E402
+from _miniature import miniature_config  # noqa: E402
+from matcha_tpu.train import train  # noqa: E402
 
 BUDGETS = (0.1, 0.25, 0.5, 1.0)
 
 
 def run_one(label: str, epochs: int, *, matcha: bool, budget: float = 1.0):
-    cfg = TrainConfig(
-        name=f"budget-sweep-{label}",
+    cfg = miniature_config(
+        f"budget-sweep-{label}", epochs,
         description="MATCHA budget sweep vs D-PSGD (paper headline, miniature)",
-        model="resnet20", dataset="synthetic_image", batch_size=8,
-        # stronger cluster separation: CIFAR-sized convnets need a per-pixel
-        # signal a 3×3-local stem can pick up within a miniature epoch budget
-        dataset_kwargs={"num_train": 4096, "num_test": 1024, "separation": 40.0},
-        num_workers=16, graphid=2, matcha=matcha, budget=budget,
-        fixed_mode="all",
-        lr=0.05, base_lr=0.05, warmup=False, epochs=epochs,
-        decay_epochs=(int(epochs * 0.6), int(epochs * 0.8)),
-        communicator="decen", save=False, eval_every=1,
-        measure_comm_split=True, seed=1,
+        matcha=matcha, budget=budget, communicator="decen",
     )
     result = train(cfg)
     hist = result.history
