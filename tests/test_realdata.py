@@ -93,10 +93,11 @@ def test_one_epoch_of_config1_on_real_shaped_npz(cifar_npz, tmp_path):
     CIFAR-10 npz) through one full epoch.  The npz is sliced to 1k/256
     examples and the model is the MLP: what this test pins is the
     load_npz → normalize → augment → partition → train plumbing on real-shaped
-    pixels, not the conv program (covered by tests/test_models.py and the
-    TPU-side harnesses) — XLA's single-core CPU LLVM backend needs 25 min to
-    compile even a vmapped ResNet-8 train step, which made the conv variant
-    of this test 80% of the whole suite's wall-clock."""
+    pixels, not the conv program (conv forward: tests/test_models.py; conv
+    *training*: test_train.py::test_train_conv_model_smoke; full-size conv
+    configs: benchmarks/run_baselines.py on TPU) — at this test's original
+    size the conv variant cost 1507 s of single-core XLA-CPU compile, 80% of
+    the whole suite's wall-clock."""
     with np.load(cifar_npz) as z:
         small = str(tmp_path / "cifar10_small.npz")
         np.savez(small, x_train=z["x_train"][:1024], y_train=z["y_train"][:1024],
