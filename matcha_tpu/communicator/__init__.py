@@ -25,10 +25,14 @@ def select_communicator(
     ratio: float = 0.9,
     consensus_lr: float = 0.1,
     backend: str = "auto",
+    compressor: str = "top_k",
+    seed: int = 0,
 ) -> Communicator:
     """Registry keyed by the reference's algorithm names (README.md:17-53):
     ``decen`` (D-PSGD/MATCHA), ``choco`` (CHOCO-SGD), ``centralized``
-    (AllReduce baseline), ``none``."""
+    (AllReduce baseline), ``none``.  ``compressor`` selects CHOCO's message
+    compressor from the ops registry (``matcha_tpu.ops.COMPRESSOR_NAMES``);
+    ``seed`` seeds the stochastic compressors' PRNG carry."""
     if name == "decen":
         return make_decen(schedule, mesh=mesh, backend=backend)
     if name == "choco":
@@ -36,7 +40,8 @@ def select_communicator(
         # dense/fused/gather spellings are all the single-array batched path
         choco_backend = backend if backend in ("auto", "shard_map") else "batched"
         return make_choco(schedule, ratio=ratio, consensus_lr=consensus_lr,
-                          mesh=mesh, backend=choco_backend)
+                          mesh=mesh, backend=choco_backend,
+                          compressor=compressor, seed=seed)
     if name == "centralized":
         return make_centralized()
     if name == "none":

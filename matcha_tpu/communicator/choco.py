@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops import batched_top_k, scatter_rows
+from ..ops import scatter_rows, select_compressor
 from ..schedule import Schedule
 from .base import Communicator
 
@@ -81,6 +81,8 @@ def make_choco(
     consensus_lr: float = 0.1,
     mesh=None,
     backend: str = "auto",
+    compressor: str = "top_k",
+    seed: int = 0,
 ) -> Communicator:
     """Build the CHOCO communicator.
 
@@ -89,6 +91,14 @@ def make_choco(
     here a real parameter).  ``consensus_lr`` is γ (default matches
     train_mpi.py:228).  ``backend``: ``batched`` | ``shard_map`` | ``auto``
     (shard_map when a multi-device ``mesh`` is given).
+
+    ``compressor`` selects from the ops registry (``top_k`` | ``random_k`` |
+    ``top_k_q8``) — the extension point the reference reserves next to top-k
+    (communicator.py:186-187).  The stochastic compressors thread a PRNG key
+    through the carry (seeded by ``seed``), so runs stay reproducible and the
+    whole chain remains one compiled program.  Note the batched and shard_map
+    backends draw *different* key streams (per-array vs per-chip fold-in):
+    bit-parity across backends holds only for the deterministic ``top_k``.
     """
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
@@ -96,12 +106,18 @@ def make_choco(
     # partner masks: fixed points exchange nothing (communicator.py:210)
     partnered = (perms != np.arange(N)[None, :]).astype(np.float32)  # [M, N]
     nonempty = [bool(partnered[j].any()) for j in range(M)]
+    compress = select_compressor(compressor)
+    stochastic = compressor != "top_k"
+    cname = f"choco[r{ratio}" + ("" if compressor == "top_k" else f",{compressor}")
 
     if backend == "auto":
         backend = "shard_map" if (mesh is not None and mesh.size > 1) else "batched"
 
     def init(flat: jax.Array):
-        return {"x_hat": jnp.zeros_like(flat), "s": jnp.zeros_like(flat)}
+        carry = {"x_hat": jnp.zeros_like(flat), "s": jnp.zeros_like(flat)}
+        if stochastic:
+            carry["key"] = jax.random.PRNGKey(seed)
+        return carry
 
     def encode_probe(flat: jax.Array, x_hat: jax.Array) -> jax.Array:
         """Per-step encode cost model for the comm-split timer: the compress
@@ -109,14 +125,19 @@ def make_choco(
         CHOCO's own ``x̂ += scatter(q)`` update so XLA cannot hoist it out of
         the timing scan.  The extra [N,k] scatter is negligible next to the
         [N,D] top-k — mirrors the reference's encode window
-        (communicator.py:184-196)."""
-        vals, idx = batched_top_k(flat - x_hat, ratio)
+        (communicator.py:184-196).  Stochastic compressors get a fixed key:
+        the probe models cost, not the sample path."""
+        vals, idx = compress(flat - x_hat, ratio, jax.random.PRNGKey(0))
         return scatter_rows(x_hat, idx, vals, 1.0)
 
     if backend == "batched":
 
         def step(flat: jax.Array, carry, flags_t: jax.Array):
-            vals, idx = batched_top_k(flat - carry["x_hat"], ratio)
+            if stochastic:
+                new_key, sub = jax.random.split(carry["key"])
+            else:
+                new_key, sub = None, None
+            vals, idx = compress(flat - carry["x_hat"], ratio, sub)
 
             def gather_msg(j):
                 pi = perms[j]
@@ -128,9 +149,12 @@ def make_choco(
                 matching_nonempty=nonempty,
                 alpha=alpha, consensus_lr=consensus_lr,
             )
-            return flat, {"x_hat": x_hat, "s": s}
+            out = {"x_hat": x_hat, "s": s}
+            if stochastic:
+                out["key"] = new_key
+            return flat, out
 
-        return Communicator(name=f"choco[r{ratio}]", init=init, step=step,
+        return Communicator(name=cname + "]", init=init, step=step,
                             encode_probe=encode_probe)
 
     if backend != "shard_map":
@@ -178,40 +202,65 @@ def make_choco(
             alpha=alpha, consensus_lr=consensus_lr,
         )
 
-    def body_one(flat_blk, x_hat_blk, s_blk, flags_t):
+    def body_one(flat_blk, x_hat_blk, s_blk, flags_t, key):
         c = lax.axis_index(axis)
-        vals, idx = batched_top_k(flat_blk - x_hat_blk, ratio)
+        # per-chip key: fold the chip index so every block draws its own
+        # stream from the one replicated step key
+        sub = jax.random.fold_in(key, c) if stochastic else None
+        vals, idx = compress(flat_blk - x_hat_blk, ratio, sub)
         return chip_step(c, vals, idx, x_hat_blk, s_blk, flat_blk, flags_t)
 
-    def body_stream(flat_blk, x_hat_blk, s_blk, flags):
+    def body_stream(flat_blk, x_hat_blk, s_blk, flags, key):
+        # the key advances through the scan state exactly as the step
+        # wrapper advances the carry key, so multi_step is arithmetically
+        # identical to scanning step (the Communicator contract) and
+        # run-composition over split flag streams reproduces one long run
         def scan_body(state, flags_t):
-            f, xh, s = state
-            return body_one(f, xh, s, flags_t), None
+            f, xh, s, k = state
+            if stochastic:
+                nk, sub = jax.random.split(k)
+            else:
+                nk, sub = k, k
+            f, xh, s = body_one(f, xh, s, flags_t, sub)
+            return (f, xh, s, nk), None
 
-        (f, xh, s), _ = lax.scan(scan_body, (flat_blk, x_hat_blk, s_blk), flags)
-        return f, xh, s
+        (f, xh, s, k), _ = lax.scan(
+            scan_body, (flat_blk, x_hat_blk, s_blk, key), flags)
+        return f, xh, s, k
 
     row = P(axis, None)
-
-    def _wrap(body, flags_spec):
-        return shard_map(
-            body, mesh=mesh,
-            in_specs=(row, row, row, flags_spec),
-            out_specs=(row, row, row),
-        )
-
-    sharded_one = _wrap(body_one, P())
-    sharded_stream = _wrap(body_stream, P())
+    sharded_one = shard_map(
+        body_one, mesh=mesh,
+        in_specs=(row, row, row, P(), P()), out_specs=(row, row, row),
+    )
+    sharded_stream = shard_map(
+        body_stream, mesh=mesh,
+        in_specs=(row, row, row, P(), P()), out_specs=(row, row, row, P()),
+    )
+    _dummy = jnp.zeros((2,), jnp.uint32)  # top_k ignores its key argument
 
     def step(flat: jax.Array, carry, flags_t: jax.Array):
-        flat, x_hat, s = sharded_one(flat, carry["x_hat"], carry["s"], flags_t)
-        return flat, {"x_hat": x_hat, "s": s}
+        if stochastic:
+            new_key, sub = jax.random.split(carry["key"])
+        else:
+            new_key, sub = None, _dummy
+        flat, x_hat, s = sharded_one(flat, carry["x_hat"], carry["s"],
+                                     flags_t, sub)
+        out = {"x_hat": x_hat, "s": s}
+        if stochastic:
+            out["key"] = new_key
+        return flat, out
 
     def multi_step(flat: jax.Array, carry, flags: jax.Array):
-        flat, x_hat, s = sharded_stream(flat, carry["x_hat"], carry["s"], flags)
-        return flat, {"x_hat": x_hat, "s": s}
+        key = carry["key"] if stochastic else _dummy
+        flat, x_hat, s, new_key = sharded_stream(
+            flat, carry["x_hat"], carry["s"], flags, key)
+        out = {"x_hat": x_hat, "s": s}
+        if stochastic:
+            out["key"] = new_key
+        return flat, out
 
     return Communicator(
-        name=f"choco[r{ratio},shard_map]", init=init, step=step,
+        name=cname + ",shard_map]", init=init, step=step,
         multi_step=multi_step, encode_probe=encode_probe,
     )

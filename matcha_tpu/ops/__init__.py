@@ -1,6 +1,7 @@
 """Device-level primitive ops: batched flatten/unflatten, compressors."""
 
 from .compress import (
+    COMPRESSOR_NAMES,
     batched_random_k,
     batched_top_k,
     batched_top_k_q8,
@@ -13,6 +14,7 @@ from .compress import (
 from .flatten import WorkerFlattener, make_flattener
 
 __all__ = [
+    "COMPRESSOR_NAMES",
     "WorkerFlattener",
     "batched_random_k",
     "batched_top_k",

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "COMPRESSOR_NAMES",
     "top_k_ratio_size",
     "batched_top_k",
     "batched_random_k",
@@ -126,6 +127,10 @@ _COMPRESSORS: dict[str, Callable] = {
     "random_k": batched_random_k,
     "top_k_q8": batched_top_k_q8,
 }
+
+#: the authoritative valid-name set; config validation and CLI choices
+#: reference this so a new registry entry is visible everywhere at once
+COMPRESSOR_NAMES = tuple(_COMPRESSORS)
 
 
 def select_compressor(name: str) -> Callable:

@@ -45,10 +45,31 @@ def fold_dims(num_workers: int, mesh: Mesh, axis: str = WORKER_AXIS) -> tuple[in
     return C, num_workers // C
 
 
+def _is_prng_key_leaf(a) -> bool:
+    """A PRNG key by what the leaf *is*, not what it's named: a typed key
+    array (extended dtype) or the raw ``uint32[2]`` form PRNGKey returns."""
+    try:
+        if jax.dtypes.issubdtype(a.dtype, jax.dtypes.prng_key):
+            return True
+    except (AttributeError, TypeError):
+        pass
+    return (getattr(a, "ndim", None) == 1 and a.shape == (2,)
+            and a.dtype == np.uint32)
+
+
 def shard_workers(x, mesh: Mesh, axis: str = WORKER_AXIS):
-    """Place ``[N, ...]`` arrays with the leading axis sharded over the mesh."""
+    """Place ``[N, ...]`` arrays with the leading axis sharded over the mesh.
+
+    Two kinds of leaves are *per-program* state, not per-worker rows, and
+    replicate instead: scalars (step counters) and PRNG keys (the key a
+    stochastic compressor carries — its leading dim is key-shape, not
+    workers, and the communicators' shard_map specs declare it replicated;
+    recognized by dtype/shape, so a model submodule merely *named* ``key``
+    still shards normally).  Everything else must fold: a leading dim not
+    divisible by the axis size is a loud error, never a silent
+    re-placement."""
     def put(a):
-        if getattr(a, "ndim", 0) == 0:  # scalars (step counters) replicate
+        if getattr(a, "ndim", 0) == 0 or _is_prng_key_leaf(a):
             return jax.device_put(a, NamedSharding(mesh, P()))
         spec = P(axis, *([None] * (a.ndim - 1)))
         return jax.device_put(a, NamedSharding(mesh, spec))

@@ -57,6 +57,7 @@ class TrainConfig:
     # communicator (reference: --compress/--consensus_lr; ratio was hard-coded)
     communicator: str = "decen"  # decen|choco|centralized|none
     compress_ratio: float = 0.9
+    compressor: str = "top_k"  # choco message compressor: top_k|random_k|top_k_q8
     consensus_lr: float = 0.1
     gossip_backend: str = "auto"  # fused|dense|gather|shard_map|auto
 
@@ -79,6 +80,11 @@ class TrainConfig:
     def __post_init__(self):
         if self.communicator not in ("decen", "choco", "centralized", "none"):
             raise ValueError(f"bad communicator '{self.communicator}'")
+        from ..ops import COMPRESSOR_NAMES
+
+        if self.compressor not in COMPRESSOR_NAMES:
+            raise ValueError(f"bad compressor '{self.compressor}'; "
+                             f"have {sorted(COMPRESSOR_NAMES)}")
         if self.num_workers < 2:
             raise ValueError("need at least 2 virtual workers")
         if not 0 <= self.budget <= 1:

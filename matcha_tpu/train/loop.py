@@ -134,7 +134,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     communicator = select_communicator(
         config.communicator, schedule, mesh=mesh,
         ratio=config.compress_ratio, consensus_lr=config.consensus_lr,
-        backend=config.gossip_backend,
+        backend=config.gossip_backend, compressor=config.compressor,
+        seed=config.seed,
     )
 
     model = select_model(config.model, config.dataset,

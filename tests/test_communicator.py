@@ -199,6 +199,65 @@ def test_choco_contracts_disagreement():
     assert float(worker_disagreement(xT)) < 0.05 * float(worker_disagreement(x0))
 
 
+@pytest.mark.parametrize("compressor", ["random_k", "top_k_q8"])
+def test_choco_stochastic_compressors_contract(compressor):
+    """The registry compressors behind the reference's reserved extension
+    point (communicator.py:186-187): CHOCO must still drive consensus with a
+    random-k sparsifier and with 8-bit stochastically-quantized top-k.  The
+    PRNG key rides in the carry, so the chain stays one compiled program and
+    a rerun from the same seed is bit-identical."""
+    from matcha_tpu.parallel import worker_disagreement
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=400)
+    comm = make_choco(sched, ratio=0.7, consensus_lr=0.3,
+                      compressor=compressor, seed=5)
+    x0 = jnp.asarray(random_state(8, 30, seed=1))
+    carry0 = comm.init(x0)
+    assert "key" in carry0  # stochastic ⇒ key is part of the carried state
+    xT, carry = jax.jit(comm.run)(x0, sched.flags)
+    assert float(worker_disagreement(xT)) < 0.1 * float(worker_disagreement(x0))
+    assert not np.array_equal(np.asarray(carry["key"]), np.asarray(carry0["key"]))
+    xT2, _ = jax.jit(comm.run)(x0, sched.flags)
+    np.testing.assert_array_equal(np.asarray(xT), np.asarray(xT2))
+
+
+def test_choco_stochastic_shard_map_contracts():
+    """Stochastic compressor through the folded shard_map backend: per-chip
+    fold-in keys draw different streams than the batched form (documented in
+    make_choco), so this asserts consensus behavior, not cross-backend bit
+    parity.  Within the backend, multi_step must equal scanning step (the
+    Communicator contract): same key schedule, bit-identical state."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    from matcha_tpu.parallel import worker_disagreement
+
+    mesh = worker_mesh(8)
+    n = 16
+    sched = fixed_schedule(tp.decompose(tp.make_graph("ring", n), n, seed=0),
+                           n, iterations=300)
+    comm = make_choco(sched, ratio=0.5, consensus_lr=0.3, mesh=mesh,
+                      backend="shard_map", compressor="random_k", seed=3)
+    assert comm.multi_step is not None
+    x0 = jnp.asarray(random_state(n, 13, seed=2))
+    xs = shard_workers(x0, mesh)
+    xT, carry = jax.jit(comm.run)(xs, sched.flags)
+    assert float(worker_disagreement(xT)) < 0.1 * float(worker_disagreement(x0))
+    assert "key" in carry
+
+    # multi_step (one shard_map scan) ≡ per-step driving: the key schedule is
+    # bit-identical (same split-per-step recurrence), the state agrees up to
+    # f32 reassociation between the fused and per-step compiled programs
+    flags8 = sched.flags[:8]
+    a, ca = comm.multi_step(xs, comm.init(xs), jnp.asarray(flags8, jnp.float32))
+    b, cb = xs, comm.init(xs)
+    for t in range(8):
+        b, cb = comm.step(b, cb, jnp.asarray(flags8[t], jnp.float32))
+    np.testing.assert_array_equal(np.asarray(ca["key"]), np.asarray(cb["key"]))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ca["s"]), np.asarray(cb["s"]),
+                               rtol=1e-5, atol=1e-6)
+
+
 # ------------------------------------------------- centralized / none / registry
 
 def test_centralized_is_row_mean():
@@ -232,3 +291,23 @@ def test_registry():
     assert select_communicator("none").name == "none"
     with pytest.raises(KeyError):
         select_communicator("quantum")
+
+
+def test_select_communicator_plumbs_compressor_seed():
+    """--randomSeed must reach the stochastic compressor's PRNG carry: same
+    seed reproduces the chain bit-for-bit, different seeds draw different
+    sample paths."""
+    from matcha_tpu.communicator import select_communicator
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=40)
+    x0 = jnp.asarray(random_state(8, 17, seed=4))
+
+    def run(seed):
+        comm = select_communicator("choco", sched, compressor="random_k",
+                                   ratio=0.5, seed=seed)
+        xT, _ = comm.run(x0, sched.flags)
+        return np.asarray(xT)
+
+    a, b, c = run(1), run(1), run(2)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)

@@ -230,3 +230,22 @@ def test_dense_backend_feature_sharded_parity():
     # rather than bitwise equality
     np.testing.assert_allclose(np.asarray(want), np.asarray(got),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_shard_workers_replicates_key_leaves_and_rejects_bad_folds():
+    """PRNG-key leaves (a stochastic compressor's carried state, recognized
+    by dtype/shape rather than pytree name) replicate; worker rows shard —
+    including a float tensor that merely *sits under* a key named "key"
+    (flax attention modules do); a leading dim that cannot fold over the
+    axis stays a loud error, not a silent re-placement."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    mesh = worker_mesh(8)
+    state = {"x": jnp.zeros((8, 4)), "key": jax.random.PRNGKey(0),
+             "attn": {"key": {"kernel": jnp.zeros((8, 4))}}}
+    out = shard_workers(state, mesh)
+    assert out["key"].sharding.is_fully_replicated
+    assert not out["x"].sharding.is_fully_replicated
+    assert not out["attn"]["key"]["kernel"].sharding.is_fully_replicated
+    with pytest.raises(ValueError):
+        shard_workers({"x": jnp.zeros((3, 4))}, mesh)

@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import json
 
+from matcha_tpu.ops import COMPRESSOR_NAMES
 from matcha_tpu.train import TrainConfig, train
 
 
@@ -63,6 +64,10 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--compress", action="store_true", help="CHOCO-SGD top-k gossip")
     p.add_argument("--ratio", type=float, default=0.9,
                    help="compression ratio (keep top 1-ratio); was hard-coded in the reference")
+    p.add_argument("--compressor", default="top_k",
+                   choices=list(COMPRESSOR_NAMES),
+                   help="CHOCO message compressor (the reference's reserved "
+                        "extension point, communicator.py:186-187)")
     p.add_argument("--consensus-lr", type=float, default=0.1, dest="consensus_lr")
     p.add_argument("--centralized", action="store_true", help="AllReduce baseline")
     p.add_argument("--randomSeed", type=int, default=9001, dest="seed")
@@ -95,7 +100,8 @@ def parse_args(argv=None) -> TrainConfig:
         graphid=None if args.graphid < 0 else args.graphid,
         topology=args.topology, matcha=args.matcha, budget=args.budget,
         seed=args.seed, communicator=communicator,
-        compress_ratio=args.ratio, consensus_lr=args.consensus_lr,
+        compress_ratio=args.ratio, compressor=args.compressor,
+        consensus_lr=args.consensus_lr,
         gossip_backend=args.backend, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         eval_every=args.eval_every,
