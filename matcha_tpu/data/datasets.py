@@ -126,6 +126,23 @@ def normalized_zero(dataset: str) -> np.ndarray:
     return (-np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
 
 
+def _augment_apply_python(
+    x: np.ndarray, pad: int, pad_value, offs: np.ndarray, flip: np.ndarray
+) -> np.ndarray:
+    """Pure-Python apply path for precomputed (offs, flip) draws."""
+    n, h, w, c = x.shape
+    padded = np.broadcast_to(
+        np.asarray(pad_value, np.float32), (n, h + 2 * pad, w + 2 * pad, c)
+    ).copy()
+    padded[:, pad : pad + h, pad : pad + w, :] = x
+    out = np.empty_like(x)
+    for i in range(n):
+        oy, ox = offs[i]
+        img = padded[i, oy : oy + h, ox : ox + w]
+        out[i] = img[:, ::-1] if flip[i] else img
+    return out
+
+
 def augment_crop_flip(
     x: np.ndarray,
     rng: np.random.Generator,
@@ -133,21 +150,34 @@ def augment_crop_flip(
     pad_value: np.ndarray | float = 0.0,
 ) -> np.ndarray:
     """Random crop (pad ``pad`` with ``pad_value``) + horizontal flip — the
-    reference's CIFAR train transform (util.py:118-119), vectorized in numpy.
-    Pass ``pad_value=normalized_zero(dataset)`` for post-normalization parity."""
-    n, h, w, c = x.shape
-    padded = np.broadcast_to(
-        np.asarray(pad_value, np.float32), (n, h + 2 * pad, w + 2 * pad, c)
-    ).copy()
-    padded[:, pad : pad + h, pad : pad + w, :] = x
-    out = np.empty_like(x)
+    reference's CIFAR train transform (util.py:118-119).
+    Pass ``pad_value=normalized_zero(dataset)`` for post-normalization parity.
+
+    The random draws happen here in numpy (so the sample path is identical
+    either way); the copy work dispatches to the native C++ kernel when the
+    library is available *and* the call is in the kernel's domain — float32
+    images, pad value broadcastable per channel — falling back to the Python
+    loop otherwise, so output dtype/values never depend on whether g++ was
+    around (``tests/test_native.py`` asserts the two apply paths bit-agree).
+    A RuntimeError from the kernel propagates: with draws generated here its
+    invariant guards cannot legitimately fire, so one firing is a real bug."""
+    n, _, _, c = x.shape
     offs = rng.integers(0, 2 * pad + 1, size=(n, 2))
     flip = rng.random(n) < 0.5
-    for i in range(n):
-        oy, ox = offs[i]
-        img = padded[i, oy : oy + h, ox : ox + w]
-        out[i] = img[:, ::-1] if flip[i] else img
-    return out
+
+    use_native = x.dtype == np.float32
+    if use_native:
+        try:
+            np.broadcast_to(np.asarray(pad_value, np.float32), (c,))
+        except ValueError:
+            use_native = False
+    if use_native:
+        from ..native import native_augment_crop_flip
+
+        out = native_augment_crop_flip(x, pad, pad_value, offs, flip)
+        if out is not None:
+            return out
+    return _augment_apply_python(x, pad, pad_value, offs, flip)
 
 
 class WorkerBatches:

@@ -10,6 +10,9 @@ here the graph-builder itself is native:
   ``topology.decompose_greedy`` (reference graph_manager.py:95-154).
 * :func:`native_sample_flags` — counter-based Bernoulli flag stream
   (reference graph_manager.py:298-309), regenerable from (seed, t, j).
+* :func:`native_augment_crop_flip` — the data-loader's crop+flip batch copy
+  kernel (reference util.py:118-119); random draws stay in numpy so the
+  Python twin is bit-identical.
 
 Every entry returns ``None`` when the library is unavailable (no g++, build
 failure, or ``MATCHA_TPU_NO_NATIVE=1``) — callers fall back to Python.
@@ -26,6 +29,7 @@ from .build import build_native
 
 __all__ = [
     "native_available",
+    "native_augment_crop_flip",
     "native_edge_color",
     "native_decompose_greedy",
     "native_sample_flags",
@@ -49,6 +53,7 @@ def _load():
         return None
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
     f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
     lib.mg_edge_color.argtypes = [
         ctypes.c_int32, ctypes.c_int64, i32p, i32p, ctypes.POINTER(ctypes.c_int32),
@@ -63,6 +68,11 @@ def _load():
         ctypes.c_int64, ctypes.c_int64, f64p, ctypes.c_uint64, u8p,
     ]
     lib.sample_flag_stream.restype = ctypes.c_int
+    lib.augment_crop_flip.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, f32p, f32p, i32p, u8p, f32p,
+    ]
+    lib.augment_crop_flip.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -133,4 +143,28 @@ def native_sample_flags(
     )
     if rc != 0:
         raise RuntimeError(f"sample_flag_stream failed with code {rc}")
+    return out
+
+
+def native_augment_crop_flip(
+    x: np.ndarray, pad: int, pad_value: np.ndarray,
+    offs: np.ndarray, flip: np.ndarray,
+) -> Optional[np.ndarray]:
+    """Batch random-crop+flip copy kernel (C++ twin of the Python loop in
+    ``data.augment_crop_flip``; the random draws come in precomputed so both
+    paths are bit-identical).  ``x`` is ``float32[n,h,w,c]``, ``offs``
+    ``int32[n,2]`` in ``[0, 2·pad]``, ``flip`` ``uint8[n]``."""
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    n, h, w, c = x.shape
+    pv = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(pad_value, np.float32), (c,)))
+    offs = np.ascontiguousarray(offs, dtype=np.int32)
+    flip = np.ascontiguousarray(flip, dtype=np.uint8)
+    out = np.empty_like(x)
+    rc = lib.augment_crop_flip(n, h, w, c, pad, x, pv, offs, flip, out)
+    if rc != 0:
+        raise RuntimeError(f"augment_crop_flip failed with code {rc}")
     return out

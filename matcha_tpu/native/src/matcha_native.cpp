@@ -292,4 +292,49 @@ int greedy_decompose(int32_t n, int64_t m, const int32_t* edges_uv,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Random-crop + horizontal-flip augmentation (reference util.py:118-119)
+// ---------------------------------------------------------------------------
+//
+// The batch copy kernel behind data.augment_crop_flip: crop a virtual
+// (h+2p)×(w+2p) padding of each image at offset (oy, ox), flip horizontally
+// where flagged.  The random draws (offs, flip) stay host-side numpy so the
+// Python twin is bit-identical; this replaces its per-image Python loop —
+// the data-path hotspot on a single-core host (an [N·B, 32, 32, 3] batch is
+// ~200k independent row copies).
+
+int augment_crop_flip(int64_t n, int32_t h, int32_t w, int32_t c, int32_t pad,
+                      const float* x, const float* pad_value,
+                      const int32_t* offs, const uint8_t* flip, float* out) {
+  if (n < 0 || h <= 0 || w <= 0 || c <= 0 || pad < 0) return -1;
+  const int64_t img = (int64_t)h * w * c, row = (int64_t)w * c;
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t oy = offs[2 * i], ox = offs[2 * i + 1];
+    if (oy < 0 || oy > 2 * pad || ox < 0 || ox > 2 * pad) return -2;
+    const float* src = x + i * img;
+    float* dst = out + i * img;
+    const bool fl = flip[i] != 0;
+    for (int32_t y = 0; y < h; ++y) {
+      const int32_t iy = oy + y - pad;  // source row in unpadded coords
+      float* drow = dst + (int64_t)y * row;
+      if (iy < 0 || iy >= h) {  // fully padded row
+        for (int32_t xo = 0; xo < w; ++xo)
+          std::memcpy(drow + (int64_t)xo * c, pad_value, c * sizeof(float));
+        continue;
+      }
+      const float* srow = src + (int64_t)iy * row;
+      for (int32_t xo = 0; xo < w; ++xo) {
+        const int32_t sx = fl ? (w - 1 - xo) : xo;  // flip after crop
+        const int32_t ix = ox + sx - pad;
+        if (ix < 0 || ix >= w)
+          std::memcpy(drow + (int64_t)xo * c, pad_value, c * sizeof(float));
+        else
+          std::memcpy(drow + (int64_t)xo * c, srow + (int64_t)ix * c,
+                      c * sizeof(float));
+      }
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -103,3 +103,27 @@ def test_flag_stream_windows_composable():
     short = native_sample_flags(probs, 100, 9)
     long = native_sample_flags(probs, 200, 9)
     assert (long[:100] == short).all()
+
+
+def test_native_augment_matches_python_twin():
+    """The C++ crop+flip kernel must bit-agree with the Python apply path on
+    the same precomputed draws (the draws themselves stay in numpy, so this
+    equality makes the whole augment pipeline native/fallback-invariant)."""
+    from matcha_tpu.data.datasets import _augment_apply_python
+    from matcha_tpu.native import native_augment_crop_flip, native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 28, 28, 3)).astype(np.float32)
+    offs = rng.integers(0, 9, size=(64, 2)).astype(np.int32)
+    flip = (rng.random(64) < 0.5).astype(np.uint8)
+    for pv in (0.0, np.asarray([0.1, -0.2, 0.3], np.float32)):
+        a = native_augment_crop_flip(x, 4, pv, offs, flip)
+        b = _augment_apply_python(x, 4, pv, offs, flip)
+        np.testing.assert_array_equal(a, b)
+    # out-of-range offsets are an invariant-guard error, not silence
+    bad = offs.copy()
+    bad[0, 0] = 99
+    with pytest.raises(RuntimeError):
+        native_augment_crop_flip(x, 4, 0.0, bad, flip)
