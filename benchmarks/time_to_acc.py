@@ -41,6 +41,11 @@ RUNS = (
     ("matcha-0.5", dict(matcha=True, budget=0.5)),
     ("choco-0.5", dict(matcha=True, budget=0.5, communicator="choco",
                        compress_ratio=0.9, consensus_lr=0.3)),
+    # the comm-bound regime: the skip backend pays per *active* matching
+    # (lax.cond instead of masking), modeling the per-edge costs of the
+    # paper's clusters / DCN hops — here the budget buys measurable time
+    ("dpsgd-skip", dict(matcha=False, budget=1.0, gossip_backend="skip")),
+    ("matcha-0.5-skip", dict(matcha=True, budget=0.5, gossip_backend="skip")),
 )
 
 
@@ -120,6 +125,15 @@ def main():
             "(multi-host/MPI) regimes, which this backend has designed away "
             "at single-chip scale"
         )
+    ds, ms = by.get("dpsgd-skip"), by.get("matcha-0.5-skip")
+    if ds and ms and ds["reached"] and ms["reached"]:
+        # NOTE: the two-program comm timer cannot attribute the skip
+        # backend's effect (the cond cost/saving lands inside the train
+        # step, not the isolated gossip chain) — the per-step mechanism is
+        # pinned by benchmarks/skip_microbench.py; this records the
+        # end-to-end outcome only
+        summary["skip_backend_wall_clock_ratio"] = round(
+            ms["time_to_target_s"] / max(ds["time_to_target_s"], 1e-9), 3)
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=1)
     print(f"# wrote {args.out}", file=sys.stderr)

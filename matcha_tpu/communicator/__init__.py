@@ -36,6 +36,11 @@ def select_communicator(
     if name == "decen":
         return make_decen(schedule, mesh=mesh, backend=backend)
     if name == "choco":
+        if backend == "skip":
+            raise ValueError(
+                "choco has no 'skip' backend (its exchange is already "
+                "sparse); use communicator='decen' with backend='skip', or "
+                "a masked choco backend")
         # map the gossip backend vocabulary onto choco's two forms: the
         # dense/fused/gather spellings are all the single-array batched path
         choco_backend = backend if backend in ("auto", "shard_map") else "batched"

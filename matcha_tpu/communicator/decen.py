@@ -21,7 +21,12 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..parallel import dense_gossip_fn, gossip_mix, shard_map_gossip_fn
+from ..parallel import (
+    dense_gossip_fn,
+    gossip_mix,
+    gossip_mix_skip,
+    shard_map_gossip_fn,
+)
 from ..schedule import Schedule
 from .base import Communicator
 
@@ -44,6 +49,16 @@ def make_decen(
                           (VMEM-resident state, streamed W_t stack) for whole
                           flag streams — the bench configuration.
       * ``"gather"``    — per-matching static gathers (any N under jit).
+      * ``"skip"``      — per-matching ``lax.cond``: inactive matchings are
+                          not executed, so the MATCHA budget buys back real
+                          time where a matching's exchange is expensive.
+                          With a mesh this is the folded shard_map plan with
+                          the *collectives* inside the conds (the DCN story);
+                          single-array otherwise, where the saving is
+                          bounded by the cond identity-copy — measured
+                          honestly in benchmarks/skip_microbench.json.
+                          Masked backends spend the same time at every
+                          budget.
       * ``"shard_map"`` — explicit ppermute plan over ``mesh`` (worker-sharded,
                           the physical-decentralization path where ICI carries
                           only gossip edges).
@@ -65,6 +80,11 @@ def make_decen(
     multi_step = None
     if backend == "gather":
         mix: Callable = lambda x, w: gossip_mix(x, perms, w)
+    elif backend == "skip":
+        if mesh is not None and mesh.size > 1:
+            mix = shard_map_gossip_fn(perms, mesh, skip=True)
+        else:
+            mix = lambda x, w: gossip_mix_skip(x, perms, w)
     elif backend == "dense":
         mix = dense_gossip_fn(schedule.laplacians(), compute_dtype=compute_dtype)
     elif backend == "fused":

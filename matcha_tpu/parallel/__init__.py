@@ -7,6 +7,7 @@ from .gossip import (
     dense_gossip_fn,
     gossip_mix,
     gossip_mix_dense,
+    gossip_mix_skip,
     gossip_mix_folded,
     shard_map_gossip_fn,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "gossip_mix",
     "gossip_mix_dense",
     "gossip_mix_folded",
+    "gossip_mix_skip",
     "replicated",
     "shard_map_gossip_fn",
     "shard_workers",

@@ -46,6 +46,7 @@ from .mesh import WORKER_AXIS
 
 __all__ = [
     "gossip_mix",
+    "gossip_mix_skip",
     "gossip_mix_dense",
     "dense_gossip_fn",
     "FoldedPlan",
@@ -75,6 +76,39 @@ def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.Array
             continue  # empty matching: zero delta regardless of flag
         acc = acc + weights[j] * (x[pi] - x)
     return x + acc
+
+
+def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.Array:
+    """``gossip_mix`` with per-matching ``lax.cond`` instead of masking:
+    an inactive matching costs *nothing at runtime* (XLA compiles both
+    branches but executes only the taken one), so the MATCHA budget buys
+    real time back, not just masked-out arithmetic.
+
+    Trade-off (measured honestly in benchmarks/skip_microbench.json): the
+    cond's identity branch still writes a full-state buffer, so on-chip the
+    saving exists only while per-matching work exceeds a state copy —
+    ~1.2× at half budget for 16 workers × ResNet-20-sized state (within
+    run-to-run noise of the masked control on the tunneled chip), and
+    nothing at ResNet-18-ImageNet size where the chain is copy-bound.  The
+    regime this mechanism is actually for is the folded shard_map plan
+    (``gossip_mix_folded(skip=True)``), where the cond skips the matching's
+    cross-chip *collectives*.  Exact same arithmetic as ``gossip_mix`` for
+    the executed matchings; an all-zero flag row is a pure identity."""
+    perms = np.asarray(perms)
+    if perms.ndim != 2 or perms.shape[1] != x.shape[0]:
+        raise ValueError(f"perms {perms.shape} incompatible with x {x.shape}")
+    out = x
+    for j in range(perms.shape[0]):
+        pi = perms[j]
+        if np.all(pi == np.arange(pi.shape[0])):
+            continue
+        out = lax.cond(
+            weights[j] > 0,
+            lambda o, w=weights[j], p=pi: o + w * (x[p] - x),
+            lambda o: o,
+            out,
+        )
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -196,35 +230,58 @@ def gossip_mix_folded(
     plan: FoldedPlan,
     weights: jax.Array,
     axis: str = WORKER_AXIS,
+    skip: bool = False,
 ) -> jax.Array:
     """Per-chip body of the folded gossip step; call inside ``shard_map``.
 
     ``x_blk``: this chip's ``[L, ...]`` block of the ``[N, ...]`` worker array.
     One ``ppermute`` per (matching, nonzero offset); offset-0 edges are local
     row gathers.  Weights mask inactive matchings (communication is static).
+
+    ``skip=True`` wraps each matching's exchange in ``lax.cond`` so an
+    inactive matching's ``ppermute``s are not executed that step.  This is
+    where cond-skipping genuinely pays: the avoided cost is a cross-chip
+    (ICI/DCN) collective, not on-chip arithmetic — unlike the single-array
+    ``gossip_mix_skip``, whose saving is bounded by the cond identity-copy
+    (see benchmarks/skip_microbench.py).  The flag predicate is replicated
+    (same schedule on every chip), so all chips take the same branch and the
+    collective pattern stays deadlock-free.
     """
     C = plan.num_chips
     c = lax.axis_index(axis)
     acc = jnp.zeros_like(x_blk)
     for j, parts in enumerate(plan.matchings):
-        gathered = jnp.zeros_like(x_blk)
-        for part in parts:
-            if part.offset == 0:
-                y = x_blk
-            else:
-                pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
-                y = lax.ppermute(x_blk, axis, pairs)
-            src = jnp.asarray(part.src_local)[c]  # [L]
-            m = jnp.asarray(part.mask)[c]  # [L]
-            gathered = gathered + _bshape(m, x_blk) * y[src]
-        # masks partition all L slots, so `gathered` == x[π_j] for this block
-        acc = acc + weights[j] * (gathered - x_blk)
+
+        def matching_delta(parts=parts):
+            gathered = jnp.zeros_like(x_blk)
+            for part in parts:
+                if part.offset == 0:
+                    y = x_blk
+                else:
+                    pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
+                    y = lax.ppermute(x_blk, axis, pairs)
+                src = jnp.asarray(part.src_local)[c]  # [L]
+                m = jnp.asarray(part.mask)[c]  # [L]
+                gathered = gathered + _bshape(m, x_blk) * y[src]
+            # masks partition all L slots ⇒ `gathered` == x[π_j] here
+            return gathered - x_blk
+
+        if skip:
+            acc = acc + lax.cond(
+                weights[j] > 0,
+                lambda w=weights[j], d=matching_delta: w * d(),
+                lambda: jnp.zeros_like(x_blk),
+            )
+        else:
+            acc = acc + weights[j] * matching_delta()
     return x_blk + acc
 
 
-def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS):
+def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS,
+                        skip: bool = False):
     """Build a jittable ``(x[N,...], weights[M]) -> x[N,...]`` gossip function
-    running as an explicit shard_map over ``mesh``."""
+    running as an explicit shard_map over ``mesh``.  ``skip`` forwards to
+    :func:`gossip_mix_folded` (cond-skip inactive matchings' collectives)."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
@@ -232,7 +289,7 @@ def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS):
     plan = build_folded_plan(np.asarray(perms), C)
 
     def body(x_blk, weights):
-        return gossip_mix_folded(x_blk, plan, weights, axis=axis)
+        return gossip_mix_folded(x_blk, plan, weights, axis=axis, skip=skip)
 
     def fn(x, weights):
         spec = P(axis, *([None] * (x.ndim - 1)))

@@ -59,7 +59,7 @@ class TrainConfig:
     compress_ratio: float = 0.9
     compressor: str = "top_k"  # choco message compressor: top_k|random_k|top_k_q8
     consensus_lr: float = 0.1
-    gossip_backend: str = "auto"  # fused|dense|gather|shard_map|auto
+    gossip_backend: str = "auto"  # fused|dense|gather|skip|shard_map|auto
 
     # logging / checkpointing (reference: --save/--savePath; ckpt is new — §5.4)
     save: bool = False

@@ -249,3 +249,75 @@ def test_shard_workers_replicates_key_leaves_and_rejects_bad_folds():
     assert not out["attn"]["key"]["kernel"].sharding.is_fully_replicated
     with pytest.raises(ValueError):
         shard_workers({"x": jnp.zeros((3, 4))}, mesh)
+
+
+@pytest.mark.parametrize("gid", [0, 2, 5])
+def test_skip_backend_matches_dense_oracle(gid):
+    """The cond-skipping form must compute exactly what masking computes —
+    only the runtime cost of inactive matchings differs."""
+    from matcha_tpu.parallel import gossip_mix_skip
+
+    size = tp.graph_size(gid)
+    sched = matcha_schedule(tp.select_graph(gid), size, iterations=20,
+                            budget=0.4, seed=4)
+    x = random_state(size, 37, seed=gid)
+    for t in [0, 3, 7, 19]:
+        weights = sched.alpha * jnp.asarray(sched.flags[t], jnp.float32)
+        got = np.asarray(jax.jit(
+            lambda xx, w: gossip_mix_skip(xx, sched.perms, w)
+        )(jnp.asarray(x), weights))
+        want = dense_oracle(x, sched, t)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_skip_backend_zero_flags_is_identity_and_scans():
+    from matcha_tpu.communicator import make_decen
+    from matcha_tpu.parallel import gossip_mix_skip
+
+    sched = fixed_schedule(tp.select_graph(0), 8, iterations=3,
+                           mode="bernoulli", budget=0.0)
+    x = jnp.asarray(random_state(8, 11))
+    out = gossip_mix_skip(x, sched.perms, jnp.zeros(sched.perms.shape[0]))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    # whole varying-flag stream through the communicator under jit+scan
+    sched2 = matcha_schedule(tp.select_graph(0), 8, iterations=30,
+                             budget=0.5, seed=2)
+    comm_skip = make_decen(sched2, backend="skip")
+    comm_mask = make_decen(sched2, backend="gather")
+    x0 = jnp.asarray(random_state(8, 13, seed=3))
+    a, _ = jax.jit(comm_skip.run)(x0, sched2.flags)
+    b, _ = jax.jit(comm_mask.run)(x0, sched2.flags)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_skip_backend_shard_map_matches_masked():
+    """skip=True on the folded shard_map plan (collectives inside lax.cond)
+    must equal the masked folded plan on the same varying-flag stream —
+    64 workers folded onto 8 chips, including all-inactive steps."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    from matcha_tpu.communicator import make_decen
+
+    mesh = worker_mesh(8)
+    n = 64
+    sched = matcha_schedule(tp.decompose(tp.make_graph("geometric", n, seed=3),
+                                         n, seed=0),
+                            n, iterations=12, budget=0.3, seed=5)
+    # force one all-inactive step so the fully-skipped path is exercised too
+    flags = np.asarray(sched.flags).copy()
+    flags[5] = 0
+    x0 = jnp.asarray(random_state(n, 9, seed=7))
+    xs = shard_workers(x0, mesh)
+    a, _ = jax.jit(make_decen(sched, mesh=mesh, backend="skip").run)(xs, flags)
+    b, _ = jax.jit(make_decen(sched, mesh=mesh, backend="shard_map").run)(
+        xs, flags)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_choco_skip_backend_is_a_named_error():
+    from matcha_tpu.communicator import select_communicator
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=2)
+    with pytest.raises(ValueError, match="skip"):
+        select_communicator("choco", sched, backend="skip")
