@@ -72,7 +72,9 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--centralized", action="store_true", help="AllReduce baseline")
     p.add_argument("--randomSeed", type=int, default=9001, dest="seed")
     p.add_argument("--backend", default="auto",
-                   help="gossip backend: fused|dense|gather|shard_map|auto")
+                   help="gossip backend: fused|dense|gather|skip|shard_map|auto "
+                        "(skip = per-matching lax.cond; inactive matchings "
+                        "cost nothing, so budget < 1 buys real time)")
     p.add_argument("--fixed-mode", default="all", dest="fixed_mode",
                    help="D-PSGD flag mode: all|bernoulli|alternating "
                         "(alternating = reference ring parity, SURVEY Q1)")
