@@ -35,3 +35,27 @@ def miniature_config(name: str, epochs: int, **overrides) -> TrainConfig:
     )
     base.update(overrides)
     return TrainConfig(**base)
+
+
+def timing_stats(values):
+    """Mean plus the observed cross-rep noise band for a wall-clock quantity.
+
+    The tunneled chip shows ±10-15% run-to-run noise (VERDICT r2 item 7): a
+    claimed 1.1-1.2× speedup is meaningless without the band that could
+    manufacture or erase it, so every committed timing carries its reps and
+    ``band = (max − min) / mean``."""
+    vals = [float(v) for v in values]
+    mean = sum(vals) / len(vals)
+    return {
+        "mean": round(mean, 4),
+        "reps": [round(v, 4) for v in vals],
+        "band": round((max(vals) - min(vals)) / max(mean, 1e-9), 4),
+    }
+
+
+def ratio_range(numers, denoms):
+    """[worst, best] ratio over rep pairings — the honest bounds a
+    mean-over-mean ratio lives inside."""
+    lo = min(numers) / max(max(denoms), 1e-9)
+    hi = max(numers) / max(min(denoms), 1e-9)
+    return [round(lo, 3), round(hi, 3)]

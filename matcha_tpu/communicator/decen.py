@@ -39,6 +39,7 @@ def make_decen(
     backend: str = "auto",
     compute_dtype=jnp.float32,
     chunk: int = 1,
+    block_d: int | None = None,
 ) -> Communicator:
     """Build the gossip communicator for a schedule.
 
@@ -70,6 +71,12 @@ def make_decen(
     ``compose_mixing_stack``).  Intermediate per-step iterates are then not
     materialized, so keep the default 1 for training loops that interleave
     gossip with SGD; raise it for consensus-only chains and the bench.
+
+    ``block_d`` (fused backend only): the Pallas kernel's resident D-block
+    size; None keeps :func:`fused_gossip_run`'s default.  Per-step W-stream
+    traffic is ``ceil(D/block_d)·N²``, so bigger blocks cut HBM traffic
+    linearly until the [N, block_d] in+out blocks stop fitting VMEM
+    (~16 MB/core: 8192 is the practical max at N=256 bf16).
     """
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
@@ -79,6 +86,18 @@ def make_decen(
 
     multi_step = None
     if backend == "gather":
+        if perms.shape[1] >= 64:
+            import warnings
+
+            warnings.warn(
+                f"gossip_backend='gather' walks the full state once per "
+                f"matching and measures ~60x slower than 'dense'/'fused' at "
+                f"N={perms.shape[1]} (README Performance table: 18 vs 4764+ "
+                f"steps/s at N=256). Use backend='dense' (single chip) or "
+                f"'fused'; 'gather' remains for small-N debugging and "
+                f"oracle tests.",
+                stacklevel=2,
+            )
         mix: Callable = lambda x, w: gossip_mix(x, perms, w)
     elif backend == "skip":
         if mesh is not None and mesh.size > 1:
@@ -98,13 +117,16 @@ def make_decen(
         laplacians = schedule.laplacians()
         interpret = jax.default_backend() != "tpu"
 
+        kernel_kwargs = {} if block_d is None else {"block_d": block_d}
+
         def multi_step(flat, carry, flags):
             stack = build_mixing_stack(
                 laplacians, alpha, flags, dtype=compute_dtype
             )
             if chunk > 1:
                 stack = compose_mixing_stack(stack, chunk)
-            return fused_gossip_run(flat, stack, interpret=interpret), carry
+            return fused_gossip_run(flat, stack, interpret=interpret,
+                                    **kernel_kwargs), carry
 
     elif backend == "shard_map":
         if mesh is None:

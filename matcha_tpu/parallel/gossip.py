@@ -93,7 +93,13 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.
     regime this mechanism is actually for is the folded shard_map plan
     (``gossip_mix_folded(skip=True)``), where the cond skips the matching's
     cross-chip *collectives*.  Exact same arithmetic as ``gossip_mix`` for
-    the executed matchings; an all-zero flag row is a pure identity."""
+    the executed matchings; an all-zero flag row is a pure identity.
+
+    Do NOT call this under ``vmap``: batching lowers ``lax.cond`` to
+    ``select``, which executes *both* branches every step — the result stays
+    correct but every skip silently becomes masked work, erasing the
+    backend's entire purpose.  ``x`` must be the top-level worker-stacked
+    array; inside vmapped code use ``gossip_mix`` (masking) instead."""
     perms = np.asarray(perms)
     if perms.ndim != 2 or perms.shape[1] != x.shape[0]:
         raise ValueError(f"perms {perms.shape} incompatible with x {x.shape}")
@@ -102,8 +108,10 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.
         pi = perms[j]
         if np.all(pi == np.arange(pi.shape[0])):
             continue
+        # != 0 (not > 0) so skip stays exactly equivalent to masking for any
+        # weight sign a future schedule might produce (ADVICE r2)
         out = lax.cond(
-            weights[j] > 0,
+            weights[j] != 0,
             lambda o, w=weights[j], p=pi: o + w * (x[p] - x),
             lambda o: o,
             out,
@@ -268,7 +276,7 @@ def gossip_mix_folded(
 
         if skip:
             acc = acc + lax.cond(
-                weights[j] > 0,
+                weights[j] != 0,
                 lambda w=weights[j], d=matching_delta: w * d(),
                 lambda: jnp.zeros_like(x_blk),
             )

@@ -45,16 +45,33 @@ def fold_dims(num_workers: int, mesh: Mesh, axis: str = WORKER_AXIS) -> tuple[in
     return C, num_workers // C
 
 
-def _is_prng_key_leaf(a) -> bool:
+def _is_prng_key_leaf(a, axis_size: int | None = None) -> bool:
     """A PRNG key by what the leaf *is*, not what it's named: a typed key
-    array (extended dtype) or the raw ``uint32[2]`` form PRNGKey returns."""
+    array (extended dtype) or the raw ``uint32[2]`` form PRNGKey returns.
+
+    The raw form is a heuristic: when the mesh axis size is exactly 2, a
+    genuine per-worker ``uint32[2]`` leaf is indistinguishable from a raw key
+    and would be replicated rather than sharded — warn so the ambiguity is
+    loud, and resolve it by converting keys with ``jax.random.key`` (typed
+    keys are recognized exactly) or widening the worker leaf's dtype
+    (ADVICE r2)."""
     try:
         if jax.dtypes.issubdtype(a.dtype, jax.dtypes.prng_key):
             return True
     except (AttributeError, TypeError):
         pass
-    return (getattr(a, "ndim", None) == 1 and a.shape == (2,)
-            and a.dtype == np.uint32)
+    raw_key = (getattr(a, "ndim", None) == 1 and a.shape == (2,)
+               and a.dtype == np.uint32)
+    if raw_key and axis_size == 2:
+        import warnings
+
+        warnings.warn(
+            "shard_workers: uint32[2] leaf on a 2-wide worker axis is "
+            "ambiguous (raw PRNG key vs per-worker rows); replicating as a "
+            "key. Use jax.random.key() typed keys for exact recognition.",
+            stacklevel=3,
+        )
+    return raw_key
 
 
 def shard_workers(x, mesh: Mesh, axis: str = WORKER_AXIS):
@@ -69,7 +86,7 @@ def shard_workers(x, mesh: Mesh, axis: str = WORKER_AXIS):
     divisible by the axis size is a loud error, never a silent
     re-placement."""
     def put(a):
-        if getattr(a, "ndim", 0) == 0 or _is_prng_key_leaf(a):
+        if getattr(a, "ndim", 0) == 0 or _is_prng_key_leaf(a, mesh.shape[axis]):
             return jax.device_put(a, NamedSharding(mesh, P()))
         spec = P(axis, *([None] * (a.ndim - 1)))
         return jax.device_put(a, NamedSharding(mesh, spec))

@@ -5,10 +5,20 @@ folders, there is no ``torch.save`` anywhere (SURVEY.md §5.4).  This module
 persists the full ``TrainState``: parameters, per-worker BN stats, optimizer
 state, the communicator carry (CHOCO's ``x_hat``/``s``), and the schedule
 cursor ``step`` — the pieces a naive restart would silently lose.
+
+The schedule cursor is only meaningful relative to *the* flag stream it
+indexes: resuming step k against a schedule built with a different seed,
+budget, or graph silently de-synchronizes gossip from the solver's α — the
+exact invariant the reference leaves to identical global numpy seeding
+(graph_manager.py:298-309, SURVEY.md §5.2).  ``save_checkpoint`` therefore
+writes a schedule fingerprint sidecar, and ``restore_checkpoint`` verifies
+it (plus cursor-vs-horizon bounds) when handed the resuming schedule.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 from typing import Optional
 
@@ -18,7 +28,8 @@ import orbax.checkpoint as ocp
 
 from .state import TrainState
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step"]
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
+           "schedule_fingerprint"]
 
 
 def _manager(directory: str) -> ocp.CheckpointManager:
@@ -28,11 +39,43 @@ def _manager(directory: str) -> ocp.CheckpointManager:
     )
 
 
-def save_checkpoint(directory: str, state: TrainState, epoch: int) -> None:
+def schedule_fingerprint(schedule, flag_rows: Optional[int] = None) -> dict:
+    """Digests of everything the cursor's meaning depends on: the static part
+    (matching permutations, α, activation probabilities) and the flag stream
+    (covers both samplers — a native-vs-numpy stream difference changes the
+    digest like any seed change would).  ``flag_rows`` digests only the first
+    k rows — how restore compares a ``Schedule.extend``-ed stream against the
+    fingerprint of its shorter ancestor (both samplers are prefix-stable)."""
+    static = hashlib.sha256()
+    static.update(np.ascontiguousarray(schedule.perms, dtype=np.int32).tobytes())
+    static.update(np.float64(schedule.alpha).tobytes())
+    static.update(np.ascontiguousarray(schedule.probs, dtype=np.float64).tobytes())
+    rows = schedule.iterations if flag_rows is None else int(flag_rows)
+    flags = hashlib.sha256(
+        np.ascontiguousarray(schedule.flags[:rows], dtype=np.uint8).tobytes()
+    )
+    return {
+        "static_digest": static.hexdigest(),
+        "flags_digest": flags.hexdigest(),
+        "iterations": rows,
+        "num_matchings": int(schedule.num_matchings),
+        "num_workers": int(schedule.num_workers),
+    }
+
+
+def _sidecar_path(directory: str, epoch: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"schedule-{epoch}.json")
+
+
+def save_checkpoint(directory: str, state: TrainState, epoch: int,
+                    schedule=None) -> None:
     mgr = _manager(directory)
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
     mgr.close()
+    if schedule is not None:
+        with open(_sidecar_path(directory, epoch), "w") as f:
+            json.dump(schedule_fingerprint(schedule), f)
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -44,9 +87,17 @@ def latest_step(directory: str) -> Optional[int]:
     return step
 
 
-def restore_checkpoint(directory: str, template: TrainState, epoch: Optional[int] = None):
+def restore_checkpoint(directory: str, template: TrainState,
+                       epoch: Optional[int] = None, schedule=None):
     """Restore into the structure of ``template`` (shapes/dtypes must match).
-    Returns ``(state, epoch)``."""
+    Returns ``(state, epoch)``.
+
+    With ``schedule`` given, the restored cursor is verified against it:
+    the cursor must lie within the schedule horizon, and — when the
+    checkpoint carries a fingerprint sidecar — the schedule's static part
+    must match exactly and its flag stream must reproduce the checkpointed
+    stream's prefix.  A mismatch raises ``ValueError`` instead of silently
+    gossiping with flags the solver's α was never computed for."""
     mgr = _manager(directory)
     step = epoch if epoch is not None else mgr.latest_step()
     if step is None:
@@ -54,4 +105,36 @@ def restore_checkpoint(directory: str, template: TrainState, epoch: Optional[int
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
     state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     mgr.close()
+    if schedule is not None:
+        cursor = int(np.asarray(state.step))
+        if cursor > schedule.iterations:
+            raise ValueError(
+                f"restored schedule cursor {cursor} exceeds the resuming "
+                f"schedule's horizon {schedule.iterations}; extend() the "
+                f"schedule (or resume with the one that was checkpointed)"
+            )
+        sidecar = _sidecar_path(directory, int(step))
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                saved = json.load(f)
+            if saved["iterations"] > schedule.iterations:
+                raise ValueError(
+                    f"resuming schedule ({schedule.iterations} steps) is "
+                    f"shorter than the checkpointed stream "
+                    f"({saved['iterations']} steps); its flag stream cannot "
+                    f"be verified — rebuild with the original iterations or "
+                    f"extend()"
+                )
+            now = schedule_fingerprint(schedule, flag_rows=saved["iterations"])
+            for key in ("static_digest", "flags_digest"):
+                if now[key] != saved[key]:
+                    what = ("matchings/alpha/probs" if key == "static_digest"
+                            else "activation-flag stream")
+                    raise ValueError(
+                        f"schedule {what} differs from the checkpointed "
+                        f"schedule (fingerprint mismatch); resuming would "
+                        f"de-synchronize the gossip schedule from its "
+                        f"solver outputs. Rebuild the schedule with the "
+                        f"original graph/budget/seed/sampler."
+                    )
     return state, int(step)

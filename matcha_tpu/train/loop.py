@@ -129,7 +129,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         except ValueError:
             mesh = None
     if mesh is not None and (mesh.size == 1 or config.num_workers % mesh.size):
-        mesh = None  # single chip or non-divisible fold: gather backend
+        mesh = None  # single chip or non-divisible fold: dense backend (auto)
 
     communicator = select_communicator(
         config.communicator, schedule, mesh=mesh,
@@ -165,7 +165,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if resume_dir is None:
         resume_dir = config.resume
     if resume_dir is not None:
-        state, last_epoch = restore_checkpoint(resume_dir, state)
+        state, last_epoch = restore_checkpoint(resume_dir, state,
+                                               schedule=schedule)
         start_epoch = last_epoch + 1
 
     evaluate = make_eval_fn(model)
@@ -268,7 +269,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         if config.save and recorder.epochs_recorded % 10 == 0:
             recorder.save()  # flush cadence parity (train_mpi.py:159-160)
         if config.checkpoint_every and (epoch + 1) % config.checkpoint_every == 0:
-            save_checkpoint(f"{config.savePath}/{config.name}_ckpt", state, epoch)
+            save_checkpoint(f"{config.savePath}/{config.name}_ckpt", state,
+                            epoch, schedule=schedule)
 
     if config.save:
         recorder.save()

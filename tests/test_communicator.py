@@ -311,3 +311,25 @@ def test_select_communicator_plumbs_compressor_seed():
     a, b, c = run(1), run(1), run(2)
     np.testing.assert_array_equal(a, b)
     assert not np.array_equal(a, c)
+
+
+def test_gather_backend_warns_at_large_n():
+    """'gather' at N>=64 is a shipped footgun (~60x slower than dense at
+    N=256) — selecting it must warn loudly; small N and the fast backends
+    stay silent (VERDICT r2 item 5)."""
+    import warnings
+
+    from matcha_tpu import topology as tp
+    from matcha_tpu.schedule import fixed_schedule
+
+    n = 64
+    dec = tp.decompose(tp.make_graph("ring", n), n, seed=0)
+    sched = fixed_schedule(dec, n, iterations=2)
+    with pytest.warns(UserWarning, match="gather"):
+        make_decen(sched, backend="gather")
+    small = fixed_schedule(tp.decompose(tp.make_graph("ring", 8), 8, seed=0),
+                           8, iterations=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        make_decen(small, backend="gather")
+        make_decen(sched, backend="dense")

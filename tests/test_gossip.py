@@ -321,3 +321,37 @@ def test_choco_skip_backend_is_a_named_error():
     sched = fixed_schedule(tp.select_graph(5), 8, iterations=2)
     with pytest.raises(ValueError, match="skip"):
         select_communicator("choco", sched, backend="skip")
+
+
+def test_skip_backend_negative_weights_match_masking():
+    """The cond predicate is ``weight != 0`` (not ``> 0``): a hypothetical
+    negative mixing weight must take the exchange branch exactly like the
+    masked backends apply it (ADVICE r2)."""
+    from matcha_tpu.parallel import gossip_mix_skip
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=2)
+    x = jnp.asarray(random_state(8, 17, seed=9))
+    weights = jnp.asarray([-0.3, 0.0])  # negative active, zero inactive
+    got = jax.jit(lambda xx, w: gossip_mix_skip(xx, sched.perms, w))(x, weights)
+    want = gossip_mix(x, sched.perms, weights)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_shard_workers_warns_on_ambiguous_uint32_pair_axis2():
+    """On a 2-wide worker axis a raw ``uint32[2]`` leaf is ambiguous (key vs
+    per-worker rows); the heuristic must fire loudly, not silently (ADVICE
+    r2).  Typed keys stay silent on any axis."""
+    import warnings
+
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    mesh2 = worker_mesh(2)
+    raw = {"leaf": jnp.zeros((2,), jnp.uint32)}
+    with pytest.warns(UserWarning, match="ambiguous"):
+        out = shard_workers(raw, mesh2)
+    assert out["leaf"].sharding.is_fully_replicated
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = shard_workers({"k": jax.random.key(0)}, mesh2)
+    assert out["k"].sharding.is_fully_replicated

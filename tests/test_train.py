@@ -194,3 +194,28 @@ def test_checkpoint_resume_sharded_choco(tmp_path):
     assert r2.history[0]["epoch"] == 1
     assert int(r2.state.step) == 2 * steps_per_epoch
     assert float(jnp.abs(r2.state.comm_carry["x_hat"]).max()) > 0
+
+
+def test_checkpoint_resume_schedule_mismatch_raises(tmp_path):
+    """The cursor's meaning is the flag stream it indexes: resuming against a
+    schedule built with a different seed (different Bernoulli draws) or a
+    shorter horizon must raise, not silently de-synchronize gossip from the
+    solver's α (VERDICT r2 item 8; the invariant the reference leaves to
+    identical global numpy seeding, graph_manager.py:298-309)."""
+    cfg = dataclasses.replace(
+        BASE, epochs=2, checkpoint_every=1, savePath=str(tmp_path))
+    train(cfg)
+    ckpt = f"{cfg.savePath}/{cfg.name}_ckpt"
+    # different seed => different flag stream => fingerprint mismatch
+    cfg_bad = dataclasses.replace(cfg, epochs=3, checkpoint_every=0, seed=99)
+    with pytest.raises(ValueError, match="flag stream|fingerprint"):
+        train(cfg_bad, resume_dir=ckpt)
+    # shorter horizon than the checkpointed stream => unverifiable => raises
+    cfg_short = dataclasses.replace(cfg, epochs=1, checkpoint_every=0)
+    with pytest.raises(ValueError, match="exceeds|shorter"):
+        train(cfg_short, resume_dir=ckpt)
+    # different budget => different probs/alpha => static fingerprint mismatch
+    cfg_budget = dataclasses.replace(cfg, epochs=3, checkpoint_every=0,
+                                     budget=0.9)
+    with pytest.raises(ValueError, match="fingerprint|matchings"):
+        train(cfg_budget, resume_dir=ckpt)

@@ -74,7 +74,9 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--backend", default="auto",
                    help="gossip backend: fused|dense|gather|skip|shard_map|auto "
                         "(skip = per-matching lax.cond; inactive matchings "
-                        "cost nothing, so budget < 1 buys real time)")
+                        "cost nothing, so budget < 1 buys real time; gather "
+                        "is a small-N debugging path — ~60x slower than "
+                        "dense/fused at N>=64 and warns there)")
     p.add_argument("--fixed-mode", default="all", dest="fixed_mode",
                    help="D-PSGD flag mode: all|bernoulli|alternating "
                         "(alternating = reference ring parity, SURVEY Q1)")
