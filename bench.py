@@ -114,7 +114,8 @@ def build(args):
     return sched, x, steps, dim
 
 
-def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None):
+def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
+                 w_window=1):
     import jax
     import jax.numpy as jnp
 
@@ -132,7 +133,7 @@ def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None):
     else:
         comm = make_decen(sched, backend=backend, mesh=mesh,
                           compute_dtype=compute_dtype, chunk=chunk,
-                          block_d=block_d)
+                          block_d=block_d, w_window=w_window)
     flags = jnp.asarray(sched.flags, jnp.float32)
     if backend in ("dense", "fused"):
         x = x.astype(compute_dtype)  # state rides in the wire dtype end-to-end
@@ -226,7 +227,7 @@ def worker_main(args) -> int:
     if args.block_d == 0:
         sweep = {
             bd: time_backend("fused", sched, x, steps, args.dtype,
-                             chunk=1, block_d=bd)
+                             chunk=1, block_d=bd, w_window=args.w_window)
             for bd in (2048, 4096, 8192)
         }
         block_d = max(sweep, key=sweep.get)
@@ -236,7 +237,8 @@ def worker_main(args) -> int:
     else:
         block_d = args.block_d
         per_step = time_backend("fused", sched, x, steps, args.dtype,
-                                chunk=1, block_d=block_d)
+                                chunk=1, block_d=block_d,
+                                w_window=args.w_window)
 
     record = {
         "metric": f"per-step gossip-steps/sec @ {n} virtual workers, "
@@ -247,6 +249,7 @@ def worker_main(args) -> int:
         "backend": "fused",
         "chunk": 1,
         "block_d": block_d,
+        "w_window": args.w_window,
     }
     record.update(roofline("fused", per_step, n, dim, args.dtype,
                            block_d=block_d, chunk=1))
@@ -388,6 +391,12 @@ def main():
     p.add_argument("--block-d", type=int, default=8192,
                    help="Pallas D-block size; 0 sweeps {2048,4096,8192} on "
                         "the per-step kernel and keeps the best")
+    p.add_argument("--w-window", type=int, default=1,
+                   help="consecutive W_t per D-block grid visit in the "
+                        "per-step kernel; exact per-step arithmetic (unlike "
+                        "--chunk) — amortizes grid overhead and batches W "
+                        "DMAs. Default 1 until swept on real hardware; "
+                        "candidates {2,4,8}")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=240.0,
                    help="wall-clock bound per TPU measurement attempt (s)")
@@ -422,7 +431,8 @@ def main():
         passthrough.append("--smoke")
     passthrough += ["--backend", args.backend, "--dtype", args.dtype,
                     "--steps", str(args.steps), "--workers", str(args.workers),
-                    "--chunk", str(args.chunk), "--block-d", str(args.block_d)]
+                    "--chunk", str(args.chunk), "--block-d", str(args.block_d),
+                    "--w-window", str(args.w_window)]
     return orchestrate(args, passthrough)
 
 

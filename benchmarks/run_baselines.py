@@ -94,7 +94,7 @@ SMOKE_OVERRIDES = {
 _CONVERGE_DATA = dict(
     dataset="synthetic_image",
     dataset_kwargs={"num_train": 4096, "num_test": 1024, "separation": 40.0},
-    lr=0.05, base_lr=0.05, warmup=False, batch_size=8, eval_every=1,
+    lr=0.05, base_lr=0.05, batch_size=8, eval_every=1,
     measure_comm_split=False,
 )
 CONVERGE_OVERRIDES = {
@@ -103,8 +103,16 @@ CONVERGE_OVERRIDES = {
     # VERDICT r2 item 3 names these two: real WRN-28-10 at 16 workers and
     # the 64-worker CHOCO ResNet-20 (compressed gossip) must *learn*
     "matcha-wrn-cifar100-16w": dict(_CONVERGE_DATA, epochs=8),
-    "choco-resnet-cifar10-64w": dict(_CONVERGE_DATA, epochs=10,
-                                     consensus_lr=0.3),
+    # 64 workers split 4096 images 64-each: SGD steps per epoch are the
+    # scarce currency (a 10-epoch/batch-8 probe ran 80 steps and reached
+    # only 0.27), so batch 4 doubles steps, 24 epochs gives 384, and the
+    # top-k-compressed consensus gets lr 0.1 to move in that budget; the
+    # smaller test set keeps single-core eval FLOPs from dominating the run
+    "choco-resnet-cifar10-64w": dict(
+        _CONVERGE_DATA, epochs=24, batch_size=4, lr=0.1, base_lr=0.1,
+        consensus_lr=0.3,
+        dataset_kwargs={"num_train": 4096, "num_test": 256,
+                        "separation": 40.0}),
     "matcha-resnet50-imagenet-256w": dict(_CONVERGE_DATA, epochs=8,
                                           batch_size=4),
 }
@@ -120,7 +128,20 @@ def main():
                    help="converge tier: accuracy every run must reach")
     p.add_argument("--out", default=None,
                    help="also append JSON lines to this file")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="pin the JAX backend via jax.config (the container's "
+                        "sitecustomize overrides JAX_PLATFORMS env vars, and "
+                        "a dead TPU tunnel hangs backend init — pass cpu to "
+                        "run while the tunnel is down)")
+    p.add_argument("--no-scan-epoch", action="store_true",
+                   help="compile one train step instead of the whole epoch "
+                        "scan — slower steps, minutes less XLA-CPU compile; "
+                        "use for converge runs on a 1-core host")
     args = p.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     names = list(CONFIGS) if args.only is None else args.only.split(",")
     failures = 0
@@ -134,10 +155,12 @@ def main():
             elif args.scale == "converge":
                 cfg = dataclasses.replace(cfg, warmup=False, seed=0,
                                           **CONVERGE_OVERRIDES[cname])
-            elif args.data_root is not None:
+            elif args.data_root is not None:  # full scale with real npz data
                 cfg = dataclasses.replace(
                     cfg, datasetRoot=os.path.join(args.data_root, f"{cfg.dataset}.npz")
                 )
+            if args.no_scan_epoch:
+                cfg = dataclasses.replace(cfg, scan_epoch=False)
             t0 = time.time()
             try:
                 hist = train(cfg).history

@@ -1,0 +1,33 @@
+#!/bin/sh
+# Prioritized measurement plan for a live-TPU window (the axon tunnel is
+# intermittent — run the highest-value artifacts first; each step is
+# independently committable).  From the repo root: sh benchmarks/tpu_session.sh
+set -x
+
+# 0. liveness gate (seconds)
+timeout 90 python -c "import jax; print(jax.devices())" || exit 1
+
+# 1. THE driver artifact: per-step primary + chunked secondary (≤ ~6 min)
+python bench.py
+
+# 2. per-step kernel tuning toward the ≥5k north star: block_d sweep, then
+#    W-window sweep at the winning block size (each ≤ ~4 min)
+python bench.py --block-d 0
+python bench.py --w-window 2
+python bench.py --w-window 4
+python bench.py --w-window 8
+
+# 3. full-train-step throughput + gossip marginal at the north-star config
+python benchmarks/train_step_bench.py --out benchmarks/train_step_bench.json
+
+# 4. regenerate the timing artifacts with reps/noise bands (VERDICT r2 #7)
+python benchmarks/time_to_acc.py --reps 2
+python benchmarks/budget_sweep.py --reps 2
+
+# 5. converge tier for the configs a 1-core CPU cannot train (VERDICT r2 #3)
+python benchmarks/run_baselines.py --scale converge \
+    --only dpsgd-resnet-cifar10-8w,matcha-vgg16-cifar10-8w,matcha-wrn-cifar100-16w,matcha-resnet50-imagenet-256w \
+    --out benchmarks/baselines_converge.jsonl
+
+# 6. refresh the skip microbench (masked-control discipline)
+python benchmarks/skip_microbench.py

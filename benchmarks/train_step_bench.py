@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Full-train-step throughput at the north-star configuration.
+
+BASELINE.json's north star is worded as a *training run*: 256 virtual
+workers, ResNet-20/CIFAR-10, MATCHA budget 0.5, one gossip step per SGD step
+(/root/reference/train_mpi.py:113-145 — the loop this framework compiles
+into a single program).  bench.py isolates the gossip chain; this harness
+measures the quantity the wording implies — `make_train_step` steps/sec with
+the gossip mix fused into the compiled step — plus the **marginal cost of
+gossip** obtained by differencing against an identical run with
+`communicator="none"`, and the roofline argument that connects the two:
+
+    per train step, fwd+bwd ≈ 3 × 2 × B_total × F_model FLOPs
+    gossip adds 2·N²·D FLOPs (the dense W_t @ x mix)
+
+At N=256, B=32/worker, ResNet-20 (F ≈ 41 MFLOP/image, D = 273k):
+fwd+bwd ≈ 2.0 TFLOP vs gossip 35.8 GFLOP — gossip is ~1.8% of the step's
+FLOPs, so a MATCHA budget's saving on-chip is bounded by that share (the
+budget economy targets comm-bound fabrics; see README Performance).
+
+Run: ``python benchmarks/train_step_bench.py [--workers N] [--batch B]
+[--steps K] [--reps R] [--platform cpu|tpu] [--out PATH]``
+(CPU note: one step at the full config is ~2 TFLOP — pass
+``--workers 16 --batch 4`` for a CPU smoke.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu import topology as tp
+    from matcha_tpu.communicator import select_communicator
+    from matcha_tpu.models import ResNet
+    from matcha_tpu.schedule import matcha_schedule
+    from matcha_tpu.train import make_lr_schedule
+    from matcha_tpu.train.state import init_train_state, make_optimizer, make_train_step
+
+    n, b = args.workers, args.batch
+    model = ResNet(depth=20, num_classes=10)
+    edges = tp.make_graph("geometric", n, seed=1)
+    dec = tp.decompose(edges, n, seed=1)
+    sched = matcha_schedule(dec, n, iterations=args.steps * (args.reps + 1) + 1,
+                            budget=0.5, seed=0)
+    lr = make_lr_schedule(0.1, batches_per_epoch=100, warmup=False)
+    optimizer = make_optimizer(lr)
+
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.normal(size=(n, b, 32, 32, 3)).astype(np.float32))
+    yb = jnp.asarray(rng.integers(0, 10, size=(n, b)).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+
+    def steps_per_sec(comm_name: str) -> float:
+        comm = select_communicator(comm_name, sched)
+        state, flattener = init_train_state(
+            model, (32, 32, 3), n, optimizer, comm, seed=0)
+        step = make_train_step(model, optimizer, comm, flattener, sched.flags,
+                               lr_schedule=lr)
+
+        def chain(state):
+            for _ in range(args.steps):  # unrolled; step count is small
+                state, m = step(state, xb, yb, key)
+            return state, m
+
+        chain_j = jax.jit(chain)
+        # force completion through a scalar readback (tunneled-TPU rule:
+        # block_until_ready alone can return early — see bench.py)
+        out_state, m = chain_j(state)
+        float(m["loss"])
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            _, m = chain_j(state)
+            float(m["loss"])
+            best = min(best, time.perf_counter() - t0)
+        return args.steps / best
+
+    rate_full = steps_per_sec("decen")
+    rate_none = steps_per_sec("none")
+
+    d = 273258  # ResNet-20 flat parameter count (bench.py measures it live)
+    flops_fwd_bwd = 3 * 2 * n * b * 41.0e6  # fwd + ~2x bwd, F≈41 MFLOP/img
+    flops_gossip = 2.0 * n * n * d
+    record = {
+        "metric": f"train-steps/sec @ {n} workers x batch {b}, ResNet-20, "
+                  f"MATCHA budget 0.5 (gossip fused into the step)",
+        "value": round(rate_full, 3),
+        "unit": "train_steps_per_sec",
+        "train_steps_per_sec_no_comm": round(rate_none, 3),
+        "gossip_marginal_frac": round(
+            max(0.0, 1.0 - rate_full / max(rate_none, 1e-9)), 4),
+        "roofline": {
+            "flops_fwd_bwd_per_step": flops_fwd_bwd,
+            "flops_gossip_per_step": flops_gossip,
+            "gossip_flop_share": round(
+                flops_gossip / (flops_gossip + flops_fwd_bwd), 4),
+            "note": "gossip-steps/sec in a training run == train-steps/sec; "
+                    "the isolated gossip kernel rate (bench.py value) bounds "
+                    "the comm term, and the FLOP share bounds what any "
+                    "budget<1 can save on-chip",
+        },
+        "workers": n, "batch": b, "steps": args.steps, "reps": args.reps,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    return record
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workers", type=int, default=256)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--reps", type=int, default=2)
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    record = measure(args)
+    print(json.dumps(record))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

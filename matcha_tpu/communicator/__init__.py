@@ -27,14 +27,27 @@ def select_communicator(
     backend: str = "auto",
     compressor: str = "top_k",
     seed: int = 0,
+    block_d: int | None = None,
+    w_window: int = 1,
 ) -> Communicator:
     """Registry keyed by the reference's algorithm names (README.md:17-53):
     ``decen`` (D-PSGD/MATCHA), ``choco`` (CHOCO-SGD), ``centralized``
     (AllReduce baseline), ``none``.  ``compressor`` selects CHOCO's message
     compressor from the ops registry (``matcha_tpu.ops.COMPRESSOR_NAMES``);
-    ``seed`` seeds the stochastic compressors' PRNG carry."""
+    ``seed`` seeds the stochastic compressors' PRNG carry.  ``block_d`` and
+    ``w_window`` tune the fused Pallas kernel (decen only; see
+    :func:`make_decen`)."""
     if name == "decen":
-        return make_decen(schedule, mesh=mesh, backend=backend)
+        return make_decen(schedule, mesh=mesh, backend=backend,
+                          block_d=block_d, w_window=w_window)
+    if block_d is not None or w_window != 1:
+        import warnings
+
+        warnings.warn(
+            f"block_d/w_window tune the decen fused kernel and have no "
+            f"effect on communicator '{name}' — the flags are being ignored",
+            stacklevel=2,
+        )
     if name == "choco":
         if backend == "skip":
             raise ValueError(

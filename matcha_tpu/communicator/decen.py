@@ -40,6 +40,7 @@ def make_decen(
     compute_dtype=jnp.float32,
     chunk: int = 1,
     block_d: int | None = None,
+    w_window: int = 1,
 ) -> Communicator:
     """Build the gossip communicator for a schedule.
 
@@ -77,6 +78,11 @@ def make_decen(
     traffic is ``ceil(D/block_d)·N²``, so bigger blocks cut HBM traffic
     linearly until the [N, block_d] in+out blocks stop fitting VMEM
     (~16 MB/core: 8192 is the practical max at N=256 bf16).
+
+    ``w_window`` (fused backend only): consecutive ``W_t`` per D-block grid
+    visit.  Unlike ``chunk`` this keeps the exact per-step arithmetic (every
+    step's matmul executes in order) — it only amortizes grid overhead and
+    enlarges W DMAs, so it is valid for the training-regime measurement.
     """
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
@@ -118,6 +124,8 @@ def make_decen(
         interpret = jax.default_backend() != "tpu"
 
         kernel_kwargs = {} if block_d is None else {"block_d": block_d}
+        if w_window > 1:
+            kernel_kwargs["w_window"] = w_window
 
         def multi_step(flat, carry, flags):
             stack = build_mixing_stack(

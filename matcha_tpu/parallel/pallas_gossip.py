@@ -98,27 +98,37 @@ def compose_mixing_stack(stack: jax.Array, chunk: int) -> jax.Array:
     return w.astype(stack.dtype)
 
 
-def _kernel(x_ref, w_ref, o_ref):
-    t = pl.program_id(1)
+def _make_kernel(w_window: int):
+    def _kernel(x_ref, w_ref, o_ref):
+        t = pl.program_id(1)
 
-    @pl.when(t == 0)
-    def _():
-        o_ref[...] = x_ref[...]
+        @pl.when(t == 0)
+        def _():
+            o_ref[...] = x_ref[...]
 
-    # Cast the state into the W (wire/compute) dtype at each step's input,
-    # exactly like gossip_mix_dense does — so fused and per-step dense agree
-    # bitwise even when state dtype != compute dtype (no-op when equal).
-    o_ref[...] = jnp.dot(
-        w_ref[0], o_ref[...].astype(w_ref.dtype), preferred_element_type=jnp.float32
-    ).astype(o_ref.dtype)
+        # Cast the state into the W (wire/compute) dtype at each step's
+        # input, exactly like gossip_mix_dense does — so fused and per-step
+        # dense agree bitwise even when state dtype != compute dtype (no-op
+        # when equal).  The window loop is unrolled: each of the w_window
+        # steps in this grid visit still executes its own cast-dot-cast in
+        # stream order, so the arithmetic is step-for-step identical to
+        # w_window=1 — only the grid-step count and W DMA granularity change.
+        for k in range(w_window):
+            o_ref[...] = jnp.dot(
+                w_ref[k], o_ref[...].astype(w_ref.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(o_ref.dtype)
+
+    return _kernel
 
 
-@functools.partial(jax.jit, static_argnames=("block_d", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block_d", "w_window", "interpret"))
 def fused_gossip_run(
     x: jax.Array,
     mixing_stack: jax.Array,
     *,
     block_d: int = 2048,
+    w_window: int = 1,
     interpret: bool = False,
 ) -> jax.Array:
     """Apply ``T`` gossip steps ``x ← cast(W_t @ x)`` in one kernel launch.
@@ -128,19 +138,40 @@ def fused_gossip_run(
     f32 on the MXU and casts back to ``x.dtype`` — bit-matching the per-step
     dense backend in its wire dtype.  ``interpret=True`` runs the Pallas
     interpreter (CPU tests).
+
+    ``w_window``: number of consecutive ``W_t`` processed per grid visit of a
+    D-block.  Unlike chunked composition this does NOT change the per-step
+    arithmetic (every step's matmul executes, in order, with its own cast) —
+    it only shrinks the grid to ``(D/block_d) · T/w`` steps and lets each W
+    DMA move ``w·N²`` contiguous bytes, so per-grid-step overhead and DMA
+    latency amortize over ``w`` real steps.  Total W traffic is unchanged.
+    ``T`` not divisible by ``w_window`` is handled by *front*-padding the
+    stack with identity matrices — bitwise exact even in mixed-dtype mode:
+    the pad steps produce ``cast_state(I @ cast_wire(x))``, and the first
+    real step's input cast makes that indistinguishable from starting at
+    ``x`` (back-padding would instead round the final f32 accumulation
+    through the wire dtype).
     """
     n, d = x.shape
     t_steps = mixing_stack.shape[0]
     if mixing_stack.shape[1:] != (n, n):
         raise ValueError(f"mixing stack {mixing_stack.shape} vs state {x.shape}")
+    if t_steps == 0:
+        return x
     block_d = min(block_d, d)
-    grid = (pl.cdiv(d, block_d), t_steps)
+    w_window = max(1, min(int(w_window), t_steps))
+    pad = (-t_steps) % w_window
+    if pad:
+        eye = jnp.broadcast_to(
+            jnp.eye(n, dtype=mixing_stack.dtype), (pad, n, n))
+        mixing_stack = jnp.concatenate([eye, mixing_stack])
+    grid = (pl.cdiv(d, block_d), (t_steps + pad) // w_window)
     return pl.pallas_call(
-        _kernel,
+        _make_kernel(w_window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((n, block_d), lambda i, t: (0, i)),
-            pl.BlockSpec((1, n, n), lambda i, t: (t, 0, 0)),
+            pl.BlockSpec((w_window, n, n), lambda i, t: (t, 0, 0)),
         ],
         out_specs=pl.BlockSpec((n, block_d), lambda i, t: (0, i)),
         out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),

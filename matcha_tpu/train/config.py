@@ -60,6 +60,8 @@ class TrainConfig:
     compressor: str = "top_k"  # choco message compressor: top_k|random_k|top_k_q8
     consensus_lr: float = 0.1
     gossip_backend: str = "auto"  # fused|dense|gather|skip|shard_map|auto
+    gossip_block_d: Optional[int] = None  # fused kernel D-block (None = default)
+    gossip_w_window: int = 1  # fused kernel W_t per D-block visit (exact)
 
     # logging / checkpointing (reference: --save/--savePath; ckpt is new — §5.4)
     save: bool = False

@@ -135,7 +135,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         config.communicator, schedule, mesh=mesh,
         ratio=config.compress_ratio, consensus_lr=config.consensus_lr,
         backend=config.gossip_backend, compressor=config.compressor,
-        seed=config.seed,
+        seed=config.seed, block_d=config.gossip_block_d,
+        w_window=config.gossip_w_window,
     )
 
     model = select_model(config.model, config.dataset,

@@ -104,3 +104,21 @@ def test_compose_mixing_stack_chunked_parity():
             assert composed.shape[0] == 24
         b, _ = make_decen(sched, backend="fused", chunk=chunk).run(x0, sched.flags)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_w_window_bitwise_matches_window1():
+    """The W-window kernel executes the same per-step arithmetic (cast, dot,
+    cast, in stream order) — results must be BITWISE identical to w_window=1
+    for any window, including windows that do not divide T (front identity
+    padding) and windows >= T, in both pure-f32 and mixed bf16-wire modes."""
+    sched = _schedule(iterations=13)  # prime: nothing divides it
+    n = sched.perms.shape[1]
+    x = jnp.asarray(np.random.default_rng(11).normal(size=(n, 37)), jnp.float32)
+    flags = jnp.asarray(sched.flags, jnp.float32)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        base, _ = make_decen(sched, backend="fused",
+                             compute_dtype=dtype).run(x, flags)
+        for w in (2, 4, 5, 13, 64):
+            out, _ = make_decen(sched, backend="fused", compute_dtype=dtype,
+                                w_window=w).run(x, flags)
+            np.testing.assert_array_equal(np.asarray(base), np.asarray(out))

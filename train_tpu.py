@@ -77,6 +77,11 @@ def parse_args(argv=None) -> TrainConfig:
                         "cost nothing, so budget < 1 buys real time; gather "
                         "is a small-N debugging path — ~60x slower than "
                         "dense/fused at N>=64 and warns there)")
+    p.add_argument("--block-d", type=int, default=None, dest="block_d",
+                   help="fused-backend Pallas D-block size (default: kernel's)")
+    p.add_argument("--w-window", type=int, default=1, dest="w_window",
+                   help="fused-backend W_t steps per D-block VMEM visit "
+                        "(exact per-step arithmetic, amortizes grid overhead)")
     p.add_argument("--fixed-mode", default="all", dest="fixed_mode",
                    help="D-PSGD flag mode: all|bernoulli|alternating "
                         "(alternating = reference ring parity, SURVEY Q1)")
@@ -106,7 +111,8 @@ def parse_args(argv=None) -> TrainConfig:
         seed=args.seed, communicator=communicator,
         compress_ratio=args.ratio, compressor=args.compressor,
         consensus_lr=args.consensus_lr,
-        gossip_backend=args.backend, save=args.save, savePath=args.savePath,
+        gossip_backend=args.backend, gossip_block_d=args.block_d,
+        gossip_w_window=args.w_window, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         eval_every=args.eval_every,
         eval_batch=args.eval_batch,
