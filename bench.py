@@ -225,10 +225,14 @@ def worker_main(args) -> int:
     if args.dtype == "f32" and args.block_d > 4096:
         args.block_d = 4096
     if args.block_d == 0:
+        # f32 blocks are twice the bytes: 8192 overruns the ~16 MB/core
+        # VMEM budget, so the sweep stops at 4096 there (same guard as the
+        # explicit --block-d clamp above)
+        candidates = (2048, 4096, 8192) if args.dtype == "bf16" else (2048, 4096)
         sweep = {
             bd: time_backend("fused", sched, x, steps, args.dtype,
                              chunk=1, block_d=bd, w_window=args.w_window)
-            for bd in (2048, 4096, 8192)
+            for bd in candidates
         }
         block_d = max(sweep, key=sweep.get)
         per_step = sweep[block_d]

@@ -25,8 +25,10 @@ python benchmarks/time_to_acc.py --reps 2
 python benchmarks/budget_sweep.py --reps 2
 
 # 5. converge tier for the configs a 1-core CPU cannot train (VERDICT r2 #3)
+#    — including the 256-images-per-worker CHOCO rerun of config 4, whose
+#    64-image-shard CPU probes plateaued (see baselines_converge.jsonl)
 python benchmarks/run_baselines.py --scale converge \
-    --only dpsgd-resnet-cifar10-8w,matcha-vgg16-cifar10-8w,matcha-wrn-cifar100-16w,matcha-resnet50-imagenet-256w \
+    --only dpsgd-resnet-cifar10-8w,matcha-vgg16-cifar10-8w,matcha-wrn-cifar100-16w,choco-resnet-cifar10-64w,matcha-resnet50-imagenet-256w \
     --out benchmarks/baselines_converge.jsonl
 
 # 6. refresh the skip microbench (masked-control discipline)

@@ -52,7 +52,9 @@ def measure(args) -> dict:
     model = ResNet(depth=20, num_classes=10)
     edges = tp.make_graph("geometric", n, seed=1)
     dec = tp.decompose(edges, n, seed=1)
-    sched = matcha_schedule(dec, n, iterations=args.steps * (args.reps + 1) + 1,
+    # every chain_j(state) rep restarts from the same initial state (and
+    # therefore step 0), so only rows [0, steps) of the flag stream are read
+    sched = matcha_schedule(dec, n, iterations=args.steps + 1,
                             budget=0.5, seed=0)
     lr = make_lr_schedule(0.1, batches_per_epoch=100, warmup=False)
     optimizer = make_optimizer(lr)
@@ -121,11 +123,13 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--batch", type=int, default=32)
-    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--steps", type=int, default=4,
+                   help="train steps per timed chain (min 1)")
     p.add_argument("--reps", type=int, default=2)
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     p.add_argument("--out", default=None)
     args = p.parse_args()
+    args.steps = max(1, args.steps)
     if args.platform:
         import jax
 

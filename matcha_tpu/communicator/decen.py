@@ -90,6 +90,18 @@ def make_decen(
     if backend == "auto":
         backend = "shard_map" if (mesh is not None and mesh.size > 1) else "dense"
 
+    if backend != "fused" and (block_d is not None or w_window != 1):
+        import warnings
+
+        warnings.warn(
+            f"block_d/w_window tune the fused backend's Pallas kernel; "
+            f"backend '{backend}' ignores them. Note the fused kernel runs "
+            f"multi-step *chains* (Communicator.run / the comm-split "
+            f"timer) — the per-step training mix is a single dense matmul "
+            f"either way.",
+            stacklevel=2,
+        )
+
     multi_step = None
     if backend == "gather":
         if perms.shape[1] >= 64:

@@ -333,3 +333,22 @@ def test_gather_backend_warns_at_large_n():
         warnings.simplefilter("error")
         make_decen(small, backend="gather")
         make_decen(sched, backend="dense")
+
+
+def test_fused_knobs_warn_on_other_backends():
+    """block_d/w_window only shape the fused Pallas kernel; silently
+    accepting them on dense/gather (or non-decen communicators) misattributes
+    tuning results — both seams must warn."""
+    import warnings
+
+    from matcha_tpu import topology as tp
+    from matcha_tpu.schedule import fixed_schedule
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=2)
+    with pytest.warns(UserWarning, match="fused"):
+        make_decen(sched, backend="dense", w_window=4)
+    with pytest.warns(UserWarning, match="no effect"):
+        select_communicator("choco", sched, block_d=4096)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        make_decen(sched, backend="fused", w_window=4, block_d=512)
