@@ -10,6 +10,9 @@ artifacts this repo commits:
 * ``time_to_acc.json``   → accuracy curves + wall-clock-to-target bars with
                            the comm/compute split that carries the artifact's
                            finding (comm is ~2% on-chip, CHOCO's encode ~26%)
+* ``baselines_converge.jsonl`` → the converge-tier curves (64-worker
+                           compression study: CHOCO's shard-size plateau vs
+                           the uncompressed control reaching target)
 * a Recorder run dir (``--run-dir``) → the reference-compatible CSV series
 
 Design notes: colors are assigned to *entities* (dpsgd, matcha-0.5, ...) via
@@ -163,6 +166,53 @@ def plot_time_to_acc(path, out_dir):
     return out
 
 
+def plot_baselines_converge(path, out_dir):
+    """Converge-tier curves from the JSONL (one record per run; repeated
+    configs are distinct attempts and get an ``#k`` suffix).  Entities here
+    are configs, not the sweep algorithms — hues assigned by first
+    appearance from the same fixed palette order."""
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    records = [r for r in records if "test_acc_curve" in r]
+    if not records:
+        # smoke/error records carry no curves: nothing to draw is a benign
+        # outcome for this artifact, not a crash (main() keeps going)
+        print(f"# no converge records with curves in {path}", file=sys.stderr)
+        return None
+    palette = list(dict.fromkeys(COLORS.values()))  # dedupe aliased hues
+    # repeat attempts of one config share its hue but get progressively
+    # sparser dashes so #2 and #3 stay tellable apart
+    dashes = ["-", (0, (4, 3)), (0, (1, 2)), (0, (6, 2, 1, 2))]
+    seen: dict = {}
+    fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=150)
+    for r in records:
+        n = seen.setdefault(r["config"], {"count": 0,
+                                          "color": palette[len(seen) % len(palette)]})
+        n["count"] += 1
+        label = r["config"] if n["count"] == 1 else f"{r['config']} #{n['count']}"
+        curve = r["test_acc_curve"]
+        ax.plot(range(1, len(curve) + 1), curve, color=n["color"], linewidth=2,
+                linestyle=dashes[(n["count"] - 1) % len(dashes)],
+                label=label, zorder=3)
+    target = records[0].get("target_acc")
+    if target is not None:
+        ax.axhline(target, color=INK_2, linewidth=1, linestyle=(0, (4, 3)),
+                   zorder=2)
+        ax.annotate(f"target {target}", xy=(1, target), xytext=(2, -10),
+                    textcoords="offset points", color=INK_2, fontsize=8)
+    _style(ax, "Converge tier — test accuracy by epoch", "epoch",
+           "test accuracy")
+    ax.set_ylim(0.0, 1.05)
+    # center-right: upper-left collides with the target annotation, and the
+    # curves cluster along the bottom and the upper-right corner
+    ax.legend(frameon=False, fontsize=8, labelcolor=INK_2, loc="center right")
+    out = os.path.join(out_dir, "baselines_converge.png")
+    fig.tight_layout()
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
 def plot_run_dir(run_dir, out_dir):
     """Plot a Recorder output dir — the reference's per-rank series naming
     (util.py:410-416): ``*-tacc.log`` test accuracy, ``*-losses.log`` train
@@ -196,6 +246,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--sweep", default=os.path.join(here, "budget_sweep.json"))
     p.add_argument("--tta", default=os.path.join(here, "time_to_acc.json"))
+    p.add_argument("--converge",
+                   default=os.path.join(here, "baselines_converge.jsonl"))
     p.add_argument("--run-dir", default=None,
                    help="a Recorder output dir to plot instead of the artifacts")
     p.add_argument("--out-dir", default=os.path.join(here, "plots"))
@@ -210,6 +262,10 @@ def main():
             outs.append(plot_budget_sweep(args.sweep, args.out_dir))
         if os.path.exists(args.tta):
             outs.append(plot_time_to_acc(args.tta, args.out_dir))
+        if os.path.exists(args.converge):
+            out = plot_baselines_converge(args.converge, args.out_dir)
+            if out:
+                outs.append(out)
     for o in outs:
         print(o)
     if not outs:

@@ -24,11 +24,14 @@ def test_committed_artifacts_render(tmp_path):
     mod = _load_tool()
     sweep = os.path.join(BENCH_DIR, "budget_sweep.json")
     tta = os.path.join(BENCH_DIR, "time_to_acc.json")
-    # both artifacts are committed invariants of this repo: their absence is
+    converge = os.path.join(BENCH_DIR, "baselines_converge.jsonl")
+    # the artifacts are committed invariants of this repo: their absence is
     # itself a failure, not a skip
     assert os.path.exists(sweep) and os.path.exists(tta)
+    assert os.path.exists(converge)
     outs = [mod.plot_budget_sweep(sweep, str(tmp_path)),
-            mod.plot_time_to_acc(tta, str(tmp_path))]
+            mod.plot_time_to_acc(tta, str(tmp_path)),
+            mod.plot_baselines_converge(converge, str(tmp_path))]
     for o in outs:
         assert os.path.getsize(o) > 10_000  # a real image, not a stub
 
