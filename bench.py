@@ -101,17 +101,63 @@ def build(args):
     if args.smoke:
         n, dim, steps = 16, 4096, 50
     else:
-        # flat dimension = actual ResNet-20/CIFAR-10 parameter count
+        # flat dimension = actual ResNet-20/CIFAR-10 parameter count.
+        # eval_shape: the count needs shapes only — an actual init would
+        # compile and run the whole init program on the (tunneled) TPU,
+        # burning ~30-60 s of the bounded attempt for four numbers
         model = ResNet(depth=20, num_classes=10)
-        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        variables = jax.eval_shape(
+            lambda k: model.init(k, jnp.zeros((1, 32, 32, 3)), train=False),
+            jax.random.PRNGKey(0))
         dim = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(variables["params"]))
         steps = args.steps
 
+    sched = _cached_schedule(n, steps)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(n, dim)).astype(np.float32))
+    return sched, x, steps, dim
+
+
+def _cached_schedule(n, steps):
+    """The north-star schedule, disk-cached across worker subprocesses.
+
+    The 256-worker CVX solve + decomposition costs ~60-90 s of each bounded
+    TPU attempt (r4 postmortem: two fresh-build attempts both overran the
+    240 s attempt budget before ever timing the kernel).  The build is fully
+    deterministic (seeded graph/decomposition/solver), so cache its four
+    output arrays keyed by the build parameters; a second attempt then
+    starts timing within seconds.
+    """
+    from matcha_tpu import topology as tp
+    from matcha_tpu.schedule import matcha_schedule, Schedule
+
+    cache = f"/tmp/matcha_bench_sched_geometric_n{n}_b0.5_s{steps}_seed0.npz"
+    if os.path.exists(cache):
+        try:
+            z = np.load(cache)
+            me = z["matching_edges"]  # [K, 3] rows (matching_idx, u, v)
+            dec = [[] for _ in range(int(me[:, 0].max()) + 1)] if len(me) else []
+            for m, u, v in me:
+                dec[int(m)].append((int(u), int(v)))
+            return Schedule(
+                perms=z["perms"], alpha=float(z["alpha"]), probs=z["probs"],
+                flags=z["flags"], decomposed=dec, name="bench-north-star",
+            )
+        except Exception:  # noqa: BLE001 — corrupt cache: rebuild
+            pass
     edges = tp.make_graph("geometric", n, seed=1)
     dec = tp.decompose(edges, n, seed=1)
     sched = matcha_schedule(dec, n, iterations=steps, budget=0.5, seed=0)
-    x = jnp.asarray(np.random.default_rng(0).normal(size=(n, dim)).astype(np.float32))
-    return sched, x, steps, dim
+    me = np.asarray([(m, u, v) for m, match in enumerate(dec)
+                     for (u, v) in match], dtype=np.int32).reshape(-1, 3)
+    # suffix must stay ".npz" — np.savez appends it to any other name,
+    # which would make the os.replace source not exist
+    tmp = cache + f".tmp{os.getpid()}.npz"
+    np.savez(tmp, perms=np.asarray(sched.perms),
+             flags=np.asarray(sched.flags),
+             alpha=np.float64(sched.alpha), probs=np.asarray(sched.probs),
+             matching_edges=me)
+    os.replace(tmp, cache)
+    return sched
 
 
 def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
@@ -198,6 +244,15 @@ def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1):
 
 def worker_main(args) -> int:
     """The actual measurement; prints the final JSON line on stdout."""
+    try:
+        # persistent compile cache: a retry attempt should pay seconds, not
+        # the ~20-40 s cold compile, for programs attempt 1 already built
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is best-effort
+        pass
     sched, x, steps, dim = build(args)
     n = x.shape[0]
 
@@ -229,11 +284,20 @@ def worker_main(args) -> int:
         # VMEM budget, so the sweep stops at 4096 there (same guard as the
         # explicit --block-d clamp above)
         candidates = (2048, 4096, 8192) if args.dtype == "bf16" else (2048, 4096)
-        sweep = {
-            bd: time_backend("fused", sched, x, steps, args.dtype,
-                             chunk=1, block_d=bd, w_window=args.w_window)
-            for bd in candidates
-        }
+        sweep = {}
+        for bd in candidates:
+            # a candidate that dies in Mosaic VMEM allocation (r4 on v5e:
+            # bf16 8192 in+out blocks double-buffered ≈ the whole ~16 MB)
+            # is sweep data, not a reason to lose the configs already timed
+            try:
+                sweep[bd] = time_backend("fused", sched, x, steps, args.dtype,
+                                         chunk=1, block_d=bd,
+                                         w_window=args.w_window)
+            except Exception as e:  # noqa: BLE001
+                print(f"# block_d={bd} failed: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+        if not sweep:
+            raise RuntimeError("no block_d candidate compiled")
         block_d = max(sweep, key=sweep.get)
         per_step = sweep[block_d]
         print(f"# block_d sweep: { {b: round(v, 1) for b, v in sweep.items()} } "
@@ -257,18 +321,31 @@ def worker_main(args) -> int:
     }
     record.update(roofline("fused", per_step, n, dim, args.dtype,
                            block_d=block_d, chunk=1))
+    # print the primary the moment it exists: if the chunked secondary (or
+    # the attempt clock) dies, the parent salvages this line from partial
+    # stdout instead of losing the TPU number (r4 postmortem)
+    print(json.dumps(record))
+    sys.stdout.flush()
 
     # --- secondary: chunked chain composition (consensus-only regime) ------
     if args.chunk > 1:
         from matcha_tpu.parallel import canonical_chunk
 
         chunk = canonical_chunk(args.chunk)
+        # the chunked regime's optimum block differs from per-step (W stream
+        # is amortized ×chunk, so smaller resident blocks win): use the
+        # v5e-measured chunked optimum, not the per-step winner
         chunked = time_backend("fused", sched, x, steps, args.dtype,
-                               chunk=chunk, block_d=block_d)
+                               chunk=chunk, block_d=args.chunk_block_d)
         record["value_chunked"] = round(chunked, 1)
         record["chunk_chunked"] = chunk
+        # the top-level "w_window" applies to the per-step number only; the
+        # chunked measurement always runs at window 1 (composition already
+        # amortizes the W stream)
+        record["chunked_w_window"] = 1
+        record["chunked_block_d"] = args.chunk_block_d
         cr = roofline("fused", chunked, n, dim, args.dtype,
-                      block_d=block_d, chunk=chunk)
+                      block_d=args.chunk_block_d, chunk=chunk)
         record["chunked_mfu"] = cr.get("mfu")
 
     print(json.dumps(record))
@@ -359,6 +436,17 @@ def orchestrate(args, passthrough) -> int:
                 record["retries"] = attempts
             print(json.dumps(record))
             return 0
+        if record is not None and record.get("backend") != "cpu-fallback":
+            # the worker died or timed out AFTER printing a real measurement
+            # (the per-step primary flushes before the chunked secondary):
+            # salvage it rather than demote to the CPU provisional
+            record["partial"] = True
+            record["partial_reason"] = ("timeout" if timed_out
+                                        else f"rc={rc}")
+            if attempts:
+                record["retries"] = attempts
+            print(json.dumps(record))
+            return 0
         attempts.append({
             "attempt": i + 1, "rc": rc, "timed_out": timed_out,
             "seconds": round(secs, 1),
@@ -392,15 +480,25 @@ def main():
                         "(value_chunked): runs of S mixing matrices are "
                         "pre-multiplied (exact by associativity); 0/1 skips "
                         "the chunked measurement (v5e measured optimum: 256)")
-    p.add_argument("--block-d", type=int, default=8192,
+    p.add_argument("--block-d", type=int, default=4096,
                    help="Pallas D-block size; 0 sweeps {2048,4096,8192} on "
-                        "the per-step kernel and keeps the best")
-    p.add_argument("--w-window", type=int, default=1,
+                        "the per-step kernel and keeps the best.  Default "
+                        "4096: the r4 hardware sweep's winner on v5e "
+                        "(benchmarks/fused_sweep.json) — 8192 dies in Mosaic "
+                        "scoped-VMEM allocation there ([256,8192] bf16 "
+                        "in+out blocks double-buffered ≈ the whole ~16 MB)")
+    p.add_argument("--chunk-block-d", type=int, default=2048,
+                   help="Pallas D-block size for the chunked secondary "
+                        "measurement (its optimum differs from per-step: "
+                        "composition amortizes the W stream, so smaller "
+                        "resident blocks win — v5e optimum 2048)")
+    p.add_argument("--w-window", type=int, default=8,
                    help="consecutive W_t per D-block grid visit in the "
                         "per-step kernel; exact per-step arithmetic (unlike "
                         "--chunk) — amortizes grid overhead and batches W "
-                        "DMAs. Default 1 until swept on real hardware; "
-                        "candidates {2,4,8}")
+                        "DMAs. Default 8 = the r4 v5e sweep winner "
+                        "(5005.7 steps/s with block_d 4096, 91% MFU; "
+                        "window 32 regresses to 4512)")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=240.0,
                    help="wall-clock bound per TPU measurement attempt (s)")
@@ -411,9 +509,13 @@ def main():
                         "are clipped to what remains after the provisional")
     p.add_argument("--cpu-steps", type=int, default=5,
                    help="steps for the CPU provisional measurement")
-    p.add_argument("--retries", type=int, default=1,
+    p.add_argument("--retries", type=int, default=2,
                    help="TPU measurement attempts before promoting the "
-                        "CPU provisional record")
+                        "CPU provisional record; each is clipped to the "
+                        "remaining --total-budget (r03 left ~250 s unspent "
+                        "after a single timed-out attempt — the tunnel's "
+                        "failure mode is intermittent, so retry while the "
+                        "budget arithmetic allows)")
     p.add_argument("--in-process", action="store_true",
                    help="run the measurement in this process (no subprocess "
                         "shield); used internally for the worker")
@@ -436,6 +538,7 @@ def main():
     passthrough += ["--backend", args.backend, "--dtype", args.dtype,
                     "--steps", str(args.steps), "--workers", str(args.workers),
                     "--chunk", str(args.chunk), "--block-d", str(args.block_d),
+                    "--chunk-block-d", str(args.chunk_block_d),
                     "--w-window", str(args.w_window)]
     return orchestrate(args, passthrough)
 

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""One-process hardware sweep of the fused per-step kernel's tuning knobs.
+
+Builds the north-star problem once (256 workers, ResNet-20 D, MATCHA 0.5)
+and times the per-step fused kernel at every (block_d, w_window) candidate,
+catching per-config compile failures — round 4 found that block_d=8192
+dies in Mosaic scoped-VMEM allocation on v5e ([256, 8192] bf16 in+out blocks
+double-buffered ≈ 16 MB, the whole VMEM), an error a naive sweep turns into
+a dead process.  Also times the chunked consensus-only configuration at the
+winning block size.
+
+Usage:  python benchmarks/fused_sweep.py [--out benchmarks/fused_sweep.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402 — the repo-root harness (build + time_backend)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="benchmarks/fused_sweep.json")
+    p.add_argument("--steps", type=int, default=2000)
+    p.add_argument("--workers", type=int, default=256)
+    p.add_argument("--dtype", default="bf16")
+    p.add_argument("--block-ds", default="2048,4096,8192")
+    p.add_argument("--w-windows", default="1,2,4,8")
+    p.add_argument("--chunk", type=int, default=256)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args()
+
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    build_args = argparse.Namespace(workers=args.workers, smoke=args.smoke,
+                                    steps=args.steps)
+    t0 = time.time()
+    sched, x, steps, dim = bench.build(build_args)
+    results = {"device_kind": kind, "workers": args.workers, "dim": dim,
+               "steps": steps, "dtype": args.dtype,
+               "build_s": round(time.time() - t0, 1), "grid": []}
+
+    best = (None, 0.0)
+    for bd in [int(b) for b in args.block_ds.split(",")]:
+        for ww in [int(w) for w in args.w_windows.split(",")]:
+            t0 = time.time()
+            try:
+                rate = bench.time_backend("fused", sched, x, steps,
+                                          args.dtype, chunk=1, block_d=bd,
+                                          w_window=ww)
+                entry = {"block_d": bd, "w_window": ww,
+                         "steps_per_s": round(rate, 1),
+                         "wall_s": round(time.time() - t0, 1)}
+                if rate > best[1]:
+                    best = ((bd, ww), rate)
+            except Exception as e:  # noqa: BLE001 — per-config failure is data
+                entry = {"block_d": bd, "w_window": ww,
+                         "error": f"{type(e).__name__}: {e}"[:300],
+                         "wall_s": round(time.time() - t0, 1)}
+            results["grid"].append(entry)
+            print(json.dumps(entry), flush=True)
+
+    if best[0] is not None:
+        (bd, ww), rate = best
+        results["best"] = {"block_d": bd, "w_window": ww,
+                           "steps_per_s": round(rate, 1),
+                           "vs_north_star": round(rate / bench.NORTH_STAR, 4)}
+        results["best"].update(bench.roofline("fused", rate, args.workers,
+                                              dim, args.dtype, block_d=bd))
+        if args.chunk > 1:
+            try:
+                crate = bench.time_backend("fused", sched, x, steps,
+                                           args.dtype, chunk=args.chunk,
+                                           block_d=bd)
+                results["chunked"] = {"chunk": args.chunk, "block_d": bd,
+                                      "w_window": 1,
+                                      "steps_per_s": round(crate, 1)}
+            except Exception as e:  # noqa: BLE001
+                results["chunked"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results.get("best", {"error": "no config compiled"})))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

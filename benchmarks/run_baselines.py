@@ -109,7 +109,9 @@ _CONVERGE_DATA = dict(
     dataset="synthetic_image",
     dataset_kwargs={"num_train": 4096, "num_test": 1024, "separation": 40.0},
     lr=0.05, base_lr=0.05, batch_size=8, eval_every=1,
-    measure_comm_split=False,
+    # comm split ON (VERDICT r3 weak-2): converge artifacts must carry real
+    # comm/encode shares, not 0.0 — costs one extra gossip chain per epoch
+    measure_comm_split=True,
 )
 CONVERGE_OVERRIDES = {
     "dpsgd-resnet-cifar10-8w": dict(_CONVERGE_DATA, epochs=8),
@@ -166,6 +168,7 @@ def main():
 
     names = list(CONFIGS) if args.only is None else args.only.split(",")
     failures = 0
+    out_f = None  # before the try: open() raising must not mask itself as UnboundLocalError
     try:
         out_f = open(args.out, "a") if args.out else None
         for cname in names:
@@ -205,6 +208,7 @@ def main():
                     "comm_share": round(
                         hist[-1]["comm_time"] / max(hist[-1]["epoch_time"], 1e-9), 4
                     ),
+                    "comm_split_measured": cfg.measure_comm_split,
                 }
                 if args.scale == "converge":
                     curve = [round(float(h["test_acc_mean"]), 4) for h in hist]

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Step-0 TPU gate for the live-window runbook (VERDICT r3 item 7).
+
+A cheap on-device correctness check that runs BEFORE the expensive bench
+steps, so a broken kernel or backend surfaces as a named failure instead of
+burning the session budget:
+
+  1. backend is a real TPU (not the CPU fallback);
+  2. the Pallas fused kernel reproduces the dense MXU path on-device at
+     small N (the first non-``interpret=True`` assertion of fused == dense —
+     every ``tests/test_pallas.py`` run is CPU-interpreted by construction);
+  3. one folded shard_map gossip step matches the dense oracle on-device.
+
+Prints one JSON line; exit 0 = gate open, non-zero = named failure.
+Wall-clock is dominated by 3 small TPU compiles (~1-2 min cold).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# invoked as `python benchmarks/tpu_gate.py`: sys.path[0] is benchmarks/,
+# and matcha_tpu is not pip-installed — put the repo root on the path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(stage: str, detail: str) -> int:
+    print(json.dumps({"gate": "tpu", "ok": False, "stage": stage,
+                      "detail": detail[-300:]}))
+    return 1
+
+
+def main() -> int:
+    t0 = time.time()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        kind = jax.devices()[0].device_kind
+    except Exception as e:  # noqa: BLE001 — any backend-init failure is the finding
+        return fail("backend_init", f"{type(e).__name__}: {e}")
+    if "tpu" not in kind.lower():
+        return fail("backend_kind", f"device_kind={kind!r} is not a TPU")
+
+    from matcha_tpu import topology as tp
+    from matcha_tpu.communicator import make_decen
+    from matcha_tpu.parallel import worker_mesh
+    from matcha_tpu.schedule import matcha_schedule
+
+    n, dim, steps = 16, 4096, 20
+    edges = tp.make_graph("geometric", n, seed=1)
+    dec = tp.decompose(edges, n, seed=1)
+    sched = matcha_schedule(dec, n, iterations=steps, budget=0.5, seed=0)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(n, dim)).astype(np.float32))
+    flags = jnp.asarray(sched.flags, jnp.float32)
+
+    def run(backend, **kw):
+        comm = make_decen(sched, backend=backend, compute_dtype=jnp.float32, **kw)
+        out, _ = jax.jit(lambda x: comm.run(x, flags))(x)
+        return np.asarray(jax.device_get(out), np.float32)
+
+    try:
+        ref = run("dense")
+    except Exception as e:  # noqa: BLE001
+        return fail("dense_backend", f"{type(e).__name__}: {e}")
+    try:
+        fused = run("fused", block_d=2048)
+    except Exception as e:  # noqa: BLE001
+        return fail("pallas_compile", f"{type(e).__name__}: {e}")
+    err = float(np.max(np.abs(fused - ref)) / max(1e-12, np.max(np.abs(ref))))
+    if err > 1e-5:
+        return fail("pallas_mismatch", f"fused vs dense rel err {err:.2e} on {kind}")
+    try:
+        folded = run("shard_map", mesh=worker_mesh())
+    except Exception as e:  # noqa: BLE001
+        return fail("shard_map", f"{type(e).__name__}: {e}")
+    err_sm = float(np.max(np.abs(folded - ref)) / max(1e-12, np.max(np.abs(ref))))
+    if err_sm > 1e-5:
+        return fail("shard_map_mismatch", f"rel err {err_sm:.2e} on {kind}")
+
+    print(json.dumps({
+        "gate": "tpu", "ok": True, "device_kind": kind,
+        "fused_vs_dense_rel_err": err, "shard_map_vs_dense_rel_err": err_sm,
+        "n": n, "dim": dim, "steps": steps,
+        "wall_s": round(time.time() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

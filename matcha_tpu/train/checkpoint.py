@@ -72,10 +72,31 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
     mgr = _manager(directory)
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
+    kept = set(int(s) for s in mgr.all_steps())
     mgr.close()
     if schedule is not None:
-        with open(_sidecar_path(directory, epoch), "w") as f:
+        # atomic write: a crash mid-dump must not leave a truncated sidecar
+        # that later fails json.load during a legitimate resume
+        path = _sidecar_path(directory, epoch)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(schedule_fingerprint(schedule), f)
+        os.replace(tmp, path)
+    # prune sidecars whose step orbax (max_to_keep) has garbage-collected:
+    # on directory reuse a stale schedule-<epoch>.json from a prior run could
+    # otherwise be verified against a later checkpoint at the same epoch
+    root = os.path.abspath(directory)
+    for fname in os.listdir(root):
+        if fname.startswith("schedule-") and fname.endswith(".json"):
+            try:
+                step = int(fname[len("schedule-"):-len(".json")])
+            except ValueError:
+                continue
+            if step not in kept:
+                try:
+                    os.remove(os.path.join(root, fname))
+                except OSError:
+                    pass
 
 
 def latest_step(directory: str) -> Optional[int]:
