@@ -424,6 +424,7 @@ def orchestrate(args, passthrough) -> int:
     # (20 s slack for parent overhead + final print).
     cmd = [sys.executable, me, "--in-process"] + passthrough
     attempts = []
+    salvaged = None  # best partial record (primary printed, secondary lost)
     for i in range(args.retries):
         timeout = min(args.attempt_timeout, budget_left() - 20.0)
         if timeout < 60.0:
@@ -438,21 +439,26 @@ def orchestrate(args, passthrough) -> int:
             return 0
         if record is not None and record.get("backend") != "cpu-fallback":
             # the worker died or timed out AFTER printing a real measurement
-            # (the per-step primary flushes before the chunked secondary):
-            # salvage it rather than demote to the CPU provisional
+            # (the per-step primary flushes before the chunked secondary).
+            # Hold it as a fallback — but keep retrying while budget allows:
+            # a later attempt may land the complete record
             record["partial"] = True
             record["partial_reason"] = ("timeout" if timed_out
                                         else f"rc={rc}")
-            if attempts:
-                record["retries"] = attempts
-            print(json.dumps(record))
-            return 0
+            salvaged = record
         attempts.append({
             "attempt": i + 1, "rc": rc, "timed_out": timed_out,
             "seconds": round(secs, 1),
+            "salvaged_primary": record is not None
+            and record.get("backend") != "cpu-fallback",
             "stderr_tail": err.strip()[-300:],
         })
         print(f"# attempt {i+1} failed (rc={rc}, timeout={timed_out})", file=sys.stderr)
+
+    if salvaged is not None:
+        salvaged["retries"] = attempts
+        print(json.dumps(salvaged))
+        return 0
 
     # The TPU never produced a number: promote the provisional record.
     provisional.pop("provisional", None)

@@ -75,6 +75,14 @@ class TrainConfig:
 
     # execution
     scan_epoch: bool = True  # lax.scan over an epoch's batches (one program)
+    # batches per scanned segment (None = whole epoch in one scan).  The
+    # whole-epoch scan stages a [steps, N, B, ...] batch stack on host and
+    # device — fine at bench scales, quadratic pain at 256-worker × real
+    # dataset scale.  A chunk (e.g. 64) bounds staging memory to
+    # [chunk, N, B, ...] and pipelines: segment k+1 is stacked on host while
+    # the device still runs segment k (dispatch is async), so the device
+    # never idles on input.  Two compiled shapes at most (chunk + tail).
+    scan_chunk: Optional[int] = None
     devices: Optional[int] = None  # mesh size; None → all available
     measure_comm_split: bool = True  # two-program comp/comm timing (§5.1)
     halt_on_divergence: bool = True  # raise TrainingDiverged on NaN loss (§5.3)
@@ -91,3 +99,8 @@ class TrainConfig:
             raise ValueError("need at least 2 virtual workers")
         if not 0 <= self.budget <= 1:
             raise ValueError("budget must be in [0, 1]")
+        if self.scan_chunk is not None and self.scan_chunk < 1:
+            # a negative value would silently degenerate to the unbounded
+            # whole-epoch stack via the tail path — the opposite of what
+            # the knob promises
+            raise ValueError("scan_chunk must be None or >= 1")

@@ -18,6 +18,7 @@ initial replicas, then runs the epoch loop.  Differences by design:
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, List, Optional
 
@@ -191,9 +192,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     for epoch in range(start_epoch, config.epochs):
         t0 = time.time()
         if config.scan_epoch:
-            xs, ys = _stack_epoch(loader, epoch)
-            state, metrics = scan_step(state, xs, ys, rng)
-            epoch_metrics = {k: float(np.mean(v)) for k, v in metrics.items()}
+            state, epoch_metrics = _run_epoch_scanned(
+                scan_step, state, loader, epoch, rng, config.scan_chunk)
         else:
             sums: Dict[str, float] = {}
             count = 0
@@ -347,7 +347,11 @@ def _make_comm_timer(communicator, flattener, sample_steps: int = 32):
 
 
 def _make_epoch_scan(step_fn):
-    @jax.jit
+    # donate_argnums: the state (params + optimizer moments + CHOCO carry,
+    # replicated N ways) is the dominant persistent buffer at 256 workers —
+    # donation lets XLA write the output state into the input's memory
+    # instead of double-buffering it
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def scan_step(state, xs, ys, rng):
         def body(s, batch):
             x, y = batch
@@ -359,9 +363,61 @@ def _make_epoch_scan(step_fn):
     return scan_step
 
 
-def _stack_epoch(loader: WorkerBatches, epoch: int):
-    xs, ys = zip(*loader.epoch(epoch))
-    return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
+def _run_epoch_scanned(scan_step, state, loader: WorkerBatches, epoch: int,
+                       rng, scan_chunk: Optional[int]):
+    """One epoch through the scanned step, whole-epoch or chunk-pipelined.
+
+    ``scan_chunk=None`` stages the full ``[steps, N, B, ...]`` stack (the
+    round-3 behavior — cheapest dispatch, host memory ∝ epoch).  With a
+    chunk, batches are staged ``[chunk, N, B, ...]`` at a time; because jax
+    dispatch is asynchronous, stacking segment k+1 on the host overlaps the
+    device executing segment k — a two-deep host→device pipeline without
+    explicit double-buffering.  Metrics are weighted by segment length, so
+    the epoch means are identical to the whole-epoch scan.
+    """
+    batches = loader.epoch(epoch)
+    if not scan_chunk:
+        xs, ys = zip(*batches)
+        state, metrics = scan_step(state, jnp.asarray(np.stack(xs)),
+                                   jnp.asarray(np.stack(ys)), rng)
+        return state, {k: float(np.mean(v)) for k, v in metrics.items()}
+
+    sums: Dict[str, float] = {}
+    total = 0
+    seg_x: List[np.ndarray] = []
+    seg_y: List[np.ndarray] = []
+    pending = None  # metrics of the in-flight segment (device may still run)
+
+    def flush(metrics, n):
+        nonlocal total
+        for k, v in metrics.items():
+            sums[k] = sums.get(k, 0.0) + float(np.sum(v))
+        total += n
+
+    for xb, yb in batches:
+        seg_x.append(xb)
+        seg_y.append(yb)
+        if len(seg_x) == scan_chunk:
+            # stack + H2D + dispatch FIRST, then force the previous
+            # segment's metrics: the flush must not sit between the device
+            # going idle and the next segment's dispatch, or the promised
+            # overlap never happens (metrics are not donated, so reading
+            # them after the next dispatch is safe)
+            state, metrics = scan_step(state, jnp.asarray(np.stack(seg_x)),
+                                       jnp.asarray(np.stack(seg_y)), rng)
+            if pending is not None:
+                flush(*pending)
+            pending = (metrics, len(seg_x))
+            seg_x, seg_y = [], []
+    if seg_x:  # tail segment (its own compiled shape, at most once per run)
+        state, metrics = scan_step(state, jnp.asarray(np.stack(seg_x)),
+                                   jnp.asarray(np.stack(seg_y)), rng)
+        if pending is not None:
+            flush(*pending)
+        pending = (metrics, len(seg_x))
+    if pending is not None:
+        flush(*pending)
+    return state, {k: v / total for k, v in sums.items()}
 
 
 def _evaluate_in_batches(evaluate, state, x_test, y_test, batch: int = 512):

@@ -79,6 +79,20 @@ def test_train_python_loop_matches_scan():
     assert a["test_acc_mean"] == pytest.approx(b["test_acc_mean"], abs=1e-6)
 
 
+def test_train_chunked_scan_matches_whole_epoch_scan():
+    """scan_chunk pipelines bounded segments instead of staging the whole
+    epoch (loop.py _run_epoch_scanned); same steps in the same order, so
+    params and weighted-mean metrics must match the one-scan epoch exactly.
+    Chunk 3 against 8 workers x batch 16 gives a tail segment (the second
+    compiled shape) as well."""
+    a = train(dataclasses.replace(BASE, epochs=2)).history[-1]
+    b = train(dataclasses.replace(BASE, epochs=2, scan_chunk=3)).history[-1]
+    assert a["loss"] == pytest.approx(b["loss"], rel=1e-5)
+    assert a["accuracy"] == pytest.approx(b["accuracy"], abs=1e-6)
+    assert a["test_acc_mean"] == pytest.approx(b["test_acc_mean"], abs=1e-6)
+    assert a["disagreement"] == pytest.approx(b["disagreement"], rel=1e-4, abs=1e-8)
+
+
 @pytest.mark.parametrize("communicator", ["decen", "choco", "centralized", "none"])
 def test_train_all_communicators(communicator):
     cfg = dataclasses.replace(BASE, communicator=communicator, epochs=2)

@@ -85,6 +85,10 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--fixed-mode", default="all", dest="fixed_mode",
                    help="D-PSGD flag mode: all|bernoulli|alternating "
                         "(alternating = reference ring parity, SURVEY Q1)")
+    p.add_argument("--scan-chunk", type=int, default=0, dest="scan_chunk",
+                   help="batches per scanned segment (0 = whole-epoch scan); "
+                        "bounds host staging memory and pipelines host "
+                        "stacking against device execution at large scale")
     p.add_argument("--no-comm-split", action="store_true",
                    help="skip the per-epoch two-program comp/comm timing")
     p.add_argument("--checkpoint-every", type=int, default=0)
@@ -95,6 +99,8 @@ def parse_args(argv=None) -> TrainConfig:
                         "0 auto-sizes to keep workers x batch within HBM")
     args = p.parse_args(argv)
 
+    if args.scan_chunk < 0:
+        p.error("--scan-chunk must be >= 0 (0 = whole-epoch scan)")
     if args.compress and args.centralized:
         p.error("--compress and --centralized are mutually exclusive")
     communicator = ("choco" if args.compress
@@ -118,6 +124,7 @@ def parse_args(argv=None) -> TrainConfig:
         eval_batch=args.eval_batch,
         fixed_mode=args.fixed_mode,
         measure_comm_split=not args.no_comm_split,
+        scan_chunk=args.scan_chunk or None,
     )
     return cfg
 
