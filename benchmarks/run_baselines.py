@@ -117,8 +117,11 @@ CONVERGE_OVERRIDES = {
     "dpsgd-resnet-cifar10-8w": dict(_CONVERGE_DATA, epochs=8),
     "matcha-vgg16-cifar10-8w": dict(_CONVERGE_DATA, epochs=8),
     # VERDICT r2 item 3 names these two: real WRN-28-10 at 16 workers and
-    # the 64-worker CHOCO ResNet-20 (compressed gossip) must *learn*
-    "matcha-wrn-cifar100-16w": dict(_CONVERGE_DATA, epochs=8),
+    # the 64-worker CHOCO ResNet-20 (compressed gossip) must *learn*.
+    # remat: WRN-28-10's un-rematted 16-worker vmapped backward is
+    # activation-heavy (32x32x160 maps); block remat keeps it inside one
+    # v5e's HBM without changing the arithmetic (tested exact)
+    "matcha-wrn-cifar100-16w": dict(_CONVERGE_DATA, epochs=8, remat=True),
     # 64 workers need the same *per-worker* data density that converges at
     # 16 workers (256 images each, the budget_sweep/time_to_acc recipe that
     # reaches 0.97): two probes with 64-image shards plateaued at ~0.26
@@ -129,8 +132,11 @@ CONVERGE_OVERRIDES = {
         _CONVERGE_DATA, epochs=10, consensus_lr=0.3,
         dataset_kwargs={"num_train": 16384, "num_test": 256,
                         "separation": 40.0}),
+    # 256 workers x 224x224 ResNet-50: remat + 32-worker fwd/bwd slabs keep
+    # the folded single-chip program inside HBM (activations dominate)
     "matcha-resnet50-imagenet-256w": dict(_CONVERGE_DATA, epochs=8,
-                                          batch_size=4),
+                                          batch_size=4, remat=True,
+                                          grad_chunk=32),
     # uncompressed control for the config-4 plateau: same shard size
     # (64 images/worker), same graph/budget — D-PSGD-style dense averaging
     # instead of top-k-10% CHOCO
@@ -161,10 +167,9 @@ def main():
                         "scan — slower steps, minutes less XLA-CPU compile; "
                         "use for converge runs on a 1-core host")
     args = p.parse_args()
-    if args.platform:
-        import jax
+    from matcha_tpu.utils import pin_platform
 
-        jax.config.update("jax_platforms", args.platform)
+    pin_platform(args.platform)
 
     names = list(CONFIGS) if args.only is None else args.only.split(",")
     failures = 0

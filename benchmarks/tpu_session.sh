@@ -9,7 +9,7 @@ set -x
 #    oracle.  A failed/timed-out gate must NOT abort before bench.py — the
 #    bench self-protects and always emits a structured artifact (its CPU
 #    provisional); the gate only gates the *expensive tuning* steps below.
-timeout 240 python benchmarks/tpu_gate.py; GATE_RC=$?
+timeout 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_RC=$?
 
 # 1. THE driver artifact: per-step primary + chunked secondary (≤ ~9 min);
 #    runs even on a broken tunnel (bounded attempts + CPU provisional)
@@ -24,7 +24,8 @@ python bench.py --w-window 4
 python bench.py --w-window 8
 
 # 3. full-train-step throughput + gossip marginal at the north-star config
-python benchmarks/train_step_bench.py --out benchmarks/train_step_bench.json
+#    (--remat: the un-rematted 256x32 backward over-allocates v5e HBM)
+python benchmarks/train_step_bench.py --remat --out benchmarks/train_step_bench.json
 
 # 4. regenerate the timing artifacts with reps/noise bands (VERDICT r2 #7)
 python benchmarks/time_to_acc.py --reps 2

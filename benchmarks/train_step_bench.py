@@ -49,7 +49,7 @@ def measure(args) -> dict:
     from matcha_tpu.train.state import init_train_state, make_optimizer, make_train_step
 
     n, b = args.workers, args.batch
-    model = ResNet(depth=20, num_classes=10)
+    model = ResNet(depth=20, num_classes=10, remat=args.remat)
     edges = tp.make_graph("geometric", n, seed=1)
     dec = tp.decompose(edges, n, seed=1)
     # every chain_j(state) rep restarts from the same initial state (and
@@ -69,7 +69,8 @@ def measure(args) -> dict:
         state, flattener = init_train_state(
             model, (32, 32, 3), n, optimizer, comm, seed=0)
         step = make_train_step(model, optimizer, comm, flattener, sched.flags,
-                               lr_schedule=lr)
+                               lr_schedule=lr,
+                               grad_chunk=args.grad_chunk or None)
 
         def chain(state):
             for _ in range(args.steps):  # unrolled; step count is small
@@ -114,6 +115,7 @@ def measure(args) -> dict:
                     "budget<1 can save on-chip",
         },
         "workers": n, "batch": b, "steps": args.steps, "reps": args.reps,
+        "remat": args.remat, "grad_chunk": args.grad_chunk or None,
         "device_kind": jax.devices()[0].device_kind,
     }
     return record
@@ -126,14 +128,18 @@ def main():
     p.add_argument("--steps", type=int, default=4,
                    help="train steps per timed chain (min 1)")
     p.add_argument("--reps", type=int, default=2)
+    p.add_argument("--remat", action="store_true",
+                   help="block-level rematerialization — required to fit the "
+                        "full 256x32 config in one v5e's HBM")
+    p.add_argument("--grad-chunk", type=int, default=0, dest="grad_chunk",
+                   help="workers per fwd/bwd slab (0 = all at once)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     p.add_argument("--out", default=None)
     args = p.parse_args()
     args.steps = max(1, args.steps)
-    if args.platform:
-        import jax
+    from matcha_tpu.utils import pin_platform
 
-        jax.config.update("jax_platforms", args.platform)
+    pin_platform(args.platform)
     record = measure(args)
     print(json.dumps(record))
     if args.out:

@@ -19,6 +19,8 @@ class MLP(nn.Module):
     num_classes: int = 47
     hidden: int = 500
     dtype: Any = jnp.float32
+    remat: bool = False  # accepted for registry uniformity; a 3-layer MLP
+    # has no activation memory worth trading FLOPs for
 
     @nn.compact
     def __call__(self, x, train: bool = True):

@@ -30,23 +30,46 @@ def vgg_config(depth: int) -> Sequence[Union[int, str]]:
     return _CFG[depth]
 
 
+def _vgg_segment(mdl: "VGG", x, widths, li0: int, train: bool):
+    """One pool-to-pool run of conv+BN+ReLU units.  A plain function whose
+    first argument is the module, so ``nn.remat`` can lift it while the
+    convs keep their flat ``conv{i}``/``bn{i}`` names — the param tree is
+    identical with remat on or off (checkpoint compatibility)."""
+    li = li0
+    for w in widths:
+        x = nn.Conv(int(w), (3, 3), padding=1, use_bias=True,
+                    dtype=mdl.dtype, name=f"conv{li}")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=mdl.dtype, name=f"bn{li}")(x)
+        x = nn.relu(x)
+        li += 1
+    return x
+
+
 class VGG(nn.Module):
     depth: int = 16
     num_classes: int = 10
     dtype: Any = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        # remat granularity = pool-to-pool segment: the backward keeps only
+        # segment-boundary activations (which the pools shrink 4x each) and
+        # recomputes segment interiors
+        seg_fn = (nn.remat(_vgg_segment, static_argnums=(2, 3, 4))
+                  if self.remat else _vgg_segment)
         li = 0
+        widths: list = []
         for item in vgg_config(self.depth):
             if item == "mp":
+                x = seg_fn(self, x, tuple(widths), li, train)
+                li += len(widths)
+                widths = []
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(int(item), (3, 3), padding=1, use_bias=True,
-                            dtype=self.dtype, name=f"conv{li}")(x)
-                x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                 dtype=self.dtype, name=f"bn{li}")(x)
-                x = nn.relu(x)
-                li += 1
+                widths.append(int(item))
+        if widths:  # no config ends mid-segment, but stay total
+            x = seg_fn(self, x, tuple(widths), li, train)
         x = x.reshape((x.shape[0], -1))  # [B, 512] for 32x32 inputs
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -45,6 +45,7 @@ class WideResNet(nn.Module):
     dropout_rate: float = 0.0
     num_classes: int = 10
     dtype: Any = jnp.float32
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -52,12 +53,15 @@ class WideResNet(nn.Module):
             raise ValueError("WideResNet depth must be 6n+4")
         n = (self.depth - 4) // 6
         k = self.widen_factor
+        # block-boundary rematerialization (see models/resnet.py:_remat_block);
+        # param tree is unchanged, so checkpoints are remat-agnostic
+        block = nn.remat(WideBasic, static_argnums=(2,)) if self.remat else WideBasic
         x = nn.Conv(16, (3, 3), padding=1, use_bias=True, dtype=self.dtype, name="stem")(x)
         for stage, (planes, stride) in enumerate(zip((16 * k, 32 * k, 64 * k), (1, 2, 2))):
             for b in range(n):
-                x = WideBasic(planes=planes, stride=stride if b == 0 else 1,
-                              dropout_rate=self.dropout_rate, dtype=self.dtype,
-                              name=f"stage{stage}_block{b}")(x, train)
+                x = block(planes=planes, stride=stride if b == 0 else 1,
+                          dropout_rate=self.dropout_rate, dtype=self.dtype,
+                          name=f"stage{stage}_block{b}")(x, train)
         # torch momentum=0.9 on the final BN (wrn.py:60) == flax momentum 0.1
         x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.1,
                                  dtype=self.dtype, name="final_bn")(x))

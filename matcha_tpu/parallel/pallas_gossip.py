@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from .gossip import mxu_precision
+
 __all__ = ["build_mixing_stack", "canonical_chunk", "compose_mixing_stack", "fused_gossip_run"]
 
 
@@ -93,12 +95,16 @@ def compose_mixing_stack(stack: jax.Array, chunk: int) -> jax.Array:
                                                  (pad, n, n))])
     for _ in range(levels):
         # steps (2i, 2i+1) fuse to W_{2i+1} @ W_{2i}: later steps on the left
+        # (HIGHEST: the promised f32 products — TPU DEFAULT would drop these
+        # f32 operands to bf16 passes; composition is ~D/N cheaper than an
+        # apply, so full precision here is free)
         w = jnp.einsum("bij,bjk->bik", w[1::2], w[0::2],
+                       precision=jax.lax.Precision.HIGHEST,
                        preferred_element_type=jnp.float32)
     return w.astype(stack.dtype)
 
 
-def _make_kernel(w_window: int):
+def _make_kernel(w_window: int, precision):
     def _kernel(x_ref, w_ref, o_ref):
         t = pl.program_id(1)
 
@@ -116,6 +122,7 @@ def _make_kernel(w_window: int):
         for k in range(w_window):
             o_ref[...] = jnp.dot(
                 w_ref[k], o_ref[...].astype(w_ref.dtype),
+                precision=precision,
                 preferred_element_type=jnp.float32,
             ).astype(o_ref.dtype)
 
@@ -167,7 +174,7 @@ def fused_gossip_run(
         mixing_stack = jnp.concatenate([eye, mixing_stack])
     grid = (pl.cdiv(d, block_d), (t_steps + pad) // w_window)
     return pl.pallas_call(
-        _make_kernel(w_window),
+        _make_kernel(w_window, mxu_precision(mixing_stack.dtype)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((n, block_d), lambda i, t: (0, i)),

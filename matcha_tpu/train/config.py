@@ -74,6 +74,9 @@ class TrainConfig:
     eval_batch: int = 0
 
     # execution
+    # memory/FLOPs trades for many-workers-per-chip folding (both exact):
+    remat: bool = False  # block-level activation rematerialization
+    grad_chunk: Optional[int] = None  # workers per fwd/bwd slab (None = all)
     scan_epoch: bool = True  # lax.scan over an epoch's batches (one program)
     # batches per scanned segment (None = whole epoch in one scan).  The
     # whole-epoch scan stages a [steps, N, B, ...] batch stack on host and
@@ -104,3 +107,10 @@ class TrainConfig:
             # whole-epoch stack via the tail path — the opposite of what
             # the knob promises
             raise ValueError("scan_chunk must be None or >= 1")
+        if self.grad_chunk is not None:
+            if self.grad_chunk < 1:
+                raise ValueError("grad_chunk must be None or >= 1")
+            if self.num_workers % self.grad_chunk:
+                raise ValueError(
+                    f"grad_chunk {self.grad_chunk} must divide "
+                    f"num_workers {self.num_workers}")

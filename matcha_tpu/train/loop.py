@@ -141,7 +141,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     )
 
     model = select_model(config.model, config.dataset,
-                         num_classes=dataset.num_classes)
+                         num_classes=dataset.num_classes, remat=config.remat)
     lr_schedule = make_lr_schedule(
         config.lr, bpe, base_lr=config.base_lr, warmup=config.warmup,
         warmup_epochs=config.warmup_epochs, decay_epochs=config.decay_epochs,
@@ -160,7 +160,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
     step_fn = make_train_step(
         model, optimizer, communicator, flattener, schedule.flags,
-        dropout=False, lr_schedule=lr_schedule,
+        dropout=False, lr_schedule=lr_schedule, grad_chunk=config.grad_chunk,
     )
 
     start_epoch = 0

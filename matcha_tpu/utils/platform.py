@@ -1,0 +1,29 @@
+"""JAX backend pinning for this container.
+
+The image's sitecustomize force-registers the single-chip axon TPU backend at
+interpreter startup and the kernel env sets ``JAX_PLATFORMS=axon``, overriding
+any ``JAX_PLATFORMS``/``XLA_FLAGS`` environment variables a caller exports —
+so the only reliable way to select a backend is ``jax.config``, before first
+backend use (same trick as tests/conftest.py).  A dead TPU tunnel otherwise
+hangs backend init, which is why every entry point offers ``--platform cpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["pin_platform"]
+
+
+def pin_platform(name: Optional[str]) -> None:
+    """Pin the JAX platform (``"cpu"``/``"tpu"``) before any backend use.
+
+    ``None`` is a no-op (keep the environment's default).  Must run before
+    the first ``jax.devices()``/jit — jax.config cannot retarget an
+    initialized backend.
+    """
+    if not name:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", name)

@@ -122,6 +122,39 @@ def test_train_conv_model_smoke():
     assert np.isfinite(hist[-1]["disagreement"])
 
 
+def test_train_remat_and_grad_chunk_exact():
+    """remat (block-level rematerialization) and grad_chunk (worker-slab
+    fwd/bwd) are pure memory/FLOPs trades — both must reproduce the default
+    step bit-for-bit-ish (state.py make_train_step, models _remat_block).
+    One epoch of the conv smoke config under each knob."""
+    cfg = TrainConfig(
+        name="remat-eq", model="resnet8", dataset="synthetic_image",
+        dataset_kwargs={"num_train": 32, "num_test": 16, "separation": 40.0},
+        num_workers=4, graphid=None, topology="ring", batch_size=4, epochs=1,
+        lr=0.05, warmup=False, matcha=False, fixed_mode="all", seed=0,
+        save=False, eval_every=1, measure_comm_split=False,
+    )
+    ref = train(cfg).history[-1]
+    # grad_chunk=2 in the combined knob: with 4 workers, grad_chunk=4 would
+    # short-circuit to plain vmap and never test remat inside the lax.map
+    # slab path (the matcha-resnet50-imagenet-256w production combination)
+    for knob in ({"remat": True}, {"grad_chunk": 2},
+                 {"remat": True, "grad_chunk": 2}):
+        got = train(dataclasses.replace(cfg, **knob)).history[-1]
+        assert got["loss"] == pytest.approx(ref["loss"], rel=1e-5), knob
+        assert got["test_acc_mean"] == pytest.approx(
+            ref["test_acc_mean"], abs=1e-6), knob
+        assert got["disagreement"] == pytest.approx(
+            ref["disagreement"], rel=1e-4, abs=1e-8), knob
+
+
+def test_grad_chunk_validation():
+    with pytest.raises(ValueError, match="grad_chunk"):
+        TrainConfig(name="t", num_workers=8, grad_chunk=3)
+    with pytest.raises(ValueError, match="grad_chunk"):
+        TrainConfig(name="t", num_workers=8, grad_chunk=0)
+
+
 def test_train_fixed_dpsgd_and_generator_topology():
     cfg = dataclasses.replace(
         BASE, matcha=False, fixed_mode="all", graphid=None, topology="ring",

@@ -91,13 +91,27 @@ def parse_args(argv=None) -> TrainConfig:
                         "stacking against device execution at large scale")
     p.add_argument("--no-comm-split", action="store_true",
                    help="skip the per-epoch two-program comp/comm timing")
+    p.add_argument("--remat", action="store_true",
+                   help="block-level activation rematerialization (exact; "
+                        "trades ~1/3 more fwd FLOPs for activation HBM)")
+    p.add_argument("--grad-chunk", type=int, default=0, dest="grad_chunk",
+                   help="workers per fwd/bwd slab (0 = all at once); caps "
+                        "activation memory when folding many virtual "
+                        "workers per chip")
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", default=None, help="checkpoint dir to resume from")
     p.add_argument("--eval-every", type=int, default=1)
     p.add_argument("--eval-batch", type=int, default=0,
                    help="test-set slice per compiled eval call per worker; "
                         "0 auto-sizes to keep workers x batch within HBM")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                   help="pin the JAX backend before first use (the container "
+                        "sitecustomize overrides JAX_PLATFORMS env vars; a "
+                        "dead TPU tunnel otherwise hangs backend init)")
     args = p.parse_args(argv)
+    from matcha_tpu.utils import pin_platform
+
+    pin_platform(args.platform)
 
     if args.scan_chunk < 0:
         p.error("--scan-chunk must be >= 0 (0 = whole-epoch scan)")
@@ -125,6 +139,8 @@ def parse_args(argv=None) -> TrainConfig:
         fixed_mode=args.fixed_mode,
         measure_comm_split=not args.no_comm_split,
         scan_chunk=args.scan_chunk or None,
+        remat=args.remat,
+        grad_chunk=args.grad_chunk or None,
     )
     return cfg
 
