@@ -233,7 +233,7 @@ def main():
             print(line, flush=True)
             if out_f:
                 out_f.write(line + "\n")
-                out_f.flush()
+                out_f.flush()  # a dying tunnel must not eat completed configs
     finally:
         if out_f:
             out_f.close()

@@ -38,11 +38,15 @@ def emit(record: dict) -> None:
     # Persist only passing records: --out is the committed evidence that the
     # kernel was validated on-device, and a transient dead-tunnel failure
     # (the expected flaky-window mode) must not clobber it.  Failures still
-    # go to stdout + exit code, which is what the runbook gates on.
+    # go to stdout + exit code, which is what the runbook gates on.  Write
+    # via temp + rename: the runbook's `timeout` SIGTERM landing mid-dump
+    # must not truncate previously committed evidence either.
     if _OUT and record.get("ok"):
-        with open(_OUT, "w") as f:
+        tmp = _OUT + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(record, f, indent=1)
             f.write("\n")
+        os.replace(tmp, _OUT)
 
 
 def fail(stage: str, detail: str) -> int:

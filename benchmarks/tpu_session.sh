@@ -2,12 +2,19 @@
 # Prioritized measurement plan for a live-TPU window (the axon tunnel is
 # intermittent — run the highest-value artifacts first; each step is
 # independently committable).  From the repo root: sh benchmarks/tpu_session.sh
+#
+# r4 reordering: the fused-kernel tuning grid is already committed
+# (fused_sweep.json, 12+6 points — bench.py defaults are its winner), so the
+# open items move up: the full-train-step number and the converge tier
+# (CHOCO-at-64w convergence, configs 2/3 curves) now come right after the
+# driver artifact.
 set -x
 
 # 0. liveness + correctness gate: backend is a real TPU, the Pallas fused
 #    kernel reproduces dense on-device, one folded shard_map step matches the
-#    oracle.  A failed/timed-out gate must NOT abort before bench.py — the
-#    bench self-protects and always emits a structured artifact (its CPU
+#    oracle.  Persists passing evidence to benchmarks/tpu_gate.json.  A
+#    failed/timed-out gate must NOT abort before bench.py — the bench
+#    self-protects and always emits a structured artifact (its CPU
 #    provisional); the gate only gates the *expensive tuning* steps below.
 timeout 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_RC=$?
 
@@ -16,27 +23,25 @@ timeout 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_R
 python bench.py
 [ "$GATE_RC" -eq 0 ] || { echo "gate failed (rc=$GATE_RC): skipping tuning steps"; exit 1; }
 
-# 2. per-step kernel tuning toward the ≥5k north star: block_d sweep, then
-#    W-window sweep at the winning block size (each ≤ ~4 min)
-python bench.py --block-d 0
-python bench.py --w-window 2
-python bench.py --w-window 4
-python bench.py --w-window 8
-
-# 3. full-train-step throughput + gossip marginal at the north-star config
+# 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat: the un-rematted 256x32 backward over-allocates v5e HBM)
 python benchmarks/train_step_bench.py --remat --out benchmarks/train_step_bench.json
 
-# 4. regenerate the timing artifacts with reps/noise bands (VERDICT r2 #7)
+# 3. converge tier, highest-value configs first: the 256-images-per-worker
+#    CHOCO rerun of config 4 (VERDICT r3 item 3 — the 64-image-shard CPU
+#    probes plateaued; see baselines_converge.jsonl), then configs 2/3
+#    (VERDICT r3 item 4), then the rest.  One invocation per config so a
+#    dying tunnel loses at most the in-flight run.
+for c in choco-resnet-cifar10-64w matcha-vgg16-cifar10-8w \
+         matcha-wrn-cifar100-16w dpsgd-resnet-cifar10-8w \
+         matcha-resnet50-imagenet-256w; do
+    python benchmarks/run_baselines.py --scale converge --only "$c" \
+        --out benchmarks/baselines_converge.jsonl
+done
+
+# 4. regenerate the timing artifacts with reps/noise bands
 python benchmarks/time_to_acc.py --reps 2
 python benchmarks/budget_sweep.py --reps 2
 
-# 5. converge tier for the configs a 1-core CPU cannot train (VERDICT r2 #3)
-#    — including the 256-images-per-worker CHOCO rerun of config 4, whose
-#    64-image-shard CPU probes plateaued (see baselines_converge.jsonl)
-python benchmarks/run_baselines.py --scale converge \
-    --only dpsgd-resnet-cifar10-8w,matcha-vgg16-cifar10-8w,matcha-wrn-cifar100-16w,choco-resnet-cifar10-64w,matcha-resnet50-imagenet-256w \
-    --out benchmarks/baselines_converge.jsonl
-
-# 6. refresh the skip microbench (masked-control discipline)
+# 5. refresh the skip microbench (masked-control discipline)
 python benchmarks/skip_microbench.py

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Wait for the intermittent axon TPU tunnel to come alive, then run the
+# prioritized measurement session (tpu_session.sh) exactly once.  Probing is
+# cheap (a bounded jax.devices() call); the poll interval keeps a dead-tunnel
+# loop from hammering backend init.  Usage from the repo root:
+#     sh benchmarks/tpu_watch.sh [max_polls]
+# Exit code is tpu_session.sh's, or 3 if the tunnel never came up.
+MAX_POLLS=${1:-40}
+i=0
+while :; do
+    if timeout 90 python -c "import jax; k = jax.devices()[0].device_kind; assert 'tpu' in k.lower(), k" 2>/dev/null; then
+        echo "tunnel alive (poll $i) — starting tpu_session.sh"
+        exec sh benchmarks/tpu_session.sh
+    fi
+    i=$((i + 1))
+    [ "$i" -ge "$MAX_POLLS" ] && break
+    sleep 180  # only between probes — no trailing sleep after the last one
+done
+echo "tunnel never came alive after $MAX_POLLS polls"
+exit 3

@@ -70,9 +70,12 @@ def compose_mixing_stack(stack: jax.Array, chunk: int) -> jax.Array:
     so by associativity applying ``P_c`` once per chunk computes exactly the
     same ``x_T`` while cutting the dominant per-step cost ``2·N²·D`` down to
     ``2·N²·D/S + 2·N³`` (the N×N products are ~D/N ≈ 1000× cheaper than an
-    apply at the north-star scale).  Products accumulate in f32 regardless of
-    the wire dtype — one rounding per chunk instead of per step, so the
-    composed chain is *more* accurate than the step-by-step bf16 chain.
+    apply at the north-star scale).  Accumulation inside every product is f32
+    (``preferred_element_type``); for a bf16 stack the multiply operands
+    round to bf16 once per doubling level on TPU — log₂(S) operand roundings
+    per chunk versus S state roundings for the step-by-step chain, so the
+    composed chain is still strictly *more* accurate than stepping (an f32
+    stack composes at HIGHEST and rounds only at the final cast).
 
     ``chunk`` is rounded up to a power of two: composition runs as log₂(S)
     pairwise-doubling levels, each one big batched ``[T/2ᵏ, N, N]`` matmul —
@@ -93,13 +96,17 @@ def compose_mixing_stack(stack: jax.Array, chunk: int) -> jax.Array:
     if pad:
         w = jnp.concatenate([w, jnp.broadcast_to(jnp.eye(n, dtype=jnp.float32),
                                                  (pad, n, n))])
+    # Precision follows the *wire* dtype of the stack, decided before the f32
+    # accumulation cast: a bf16 chain keeps DEFAULT (bf16 MXU passes, f32
+    # accumulation — the log₂(S)-roundings contract in the docstring), while
+    # an f32 chain gets HIGHEST so f32 means f32 on TPU.  Unconditional
+    # HIGHEST would 6x the composition passes, and at chunk=S composition is
+    # S·N/D of the apply FLOPs (~24% at the north-star shape) — not free.
+    precision = mxu_precision(stack.dtype)
     for _ in range(levels):
         # steps (2i, 2i+1) fuse to W_{2i+1} @ W_{2i}: later steps on the left
-        # (HIGHEST: the promised f32 products — TPU DEFAULT would drop these
-        # f32 operands to bf16 passes; composition is ~D/N cheaper than an
-        # apply, so full precision here is free)
         w = jnp.einsum("bij,bjk->bik", w[1::2], w[0::2],
-                       precision=jax.lax.Precision.HIGHEST,
+                       precision=precision,
                        preferred_element_type=jnp.float32)
     return w.astype(stack.dtype)
 

@@ -355,3 +355,16 @@ def test_shard_workers_warns_on_ambiguous_uint32_pair_axis2():
         warnings.simplefilter("error")
         out = shard_workers({"k": jax.random.key(0)}, mesh2)
     assert out["k"].sharding.is_fully_replicated
+
+
+def test_mxu_precision_contract():
+    """f32 compute must request HIGHEST (TPU DEFAULT degrades f32 matmuls to
+    one bf16 MXU pass — the r4 on-device gate caught a 4e-2 drift from the
+    exact gather path); bf16 keeps DEFAULT, the native MXU input precision
+    the perf path is specified in (gossip.py mxu_precision)."""
+    from matcha_tpu.parallel.gossip import mxu_precision
+
+    assert mxu_precision(jnp.float32) == jax.lax.Precision.HIGHEST
+    assert mxu_precision(jnp.float64) == jax.lax.Precision.HIGHEST
+    assert mxu_precision(jnp.bfloat16) == jax.lax.Precision.DEFAULT
+    assert mxu_precision(jnp.float16) == jax.lax.Precision.DEFAULT
