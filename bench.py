@@ -161,7 +161,7 @@ def _cached_schedule(n, steps):
 
 
 def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
-                 w_window=1):
+                 w_window=1, reps=3):
     import jax
     import jax.numpy as jnp
 
@@ -191,7 +191,7 @@ def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
     # whole chain (every output column depends on all T steps).
     run = jax.jit(lambda x: jnp.sum(comm.run(x, flags)[0][:, :8].astype(jnp.float32)))
     float(run(x))  # compile + warmup, forced to completion
-    reps, best = 3, float("inf")
+    best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         float(run(x))
@@ -244,15 +244,12 @@ def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1):
 
 def worker_main(args) -> int:
     """The actual measurement; prints the final JSON line on stdout."""
-    try:
-        # persistent compile cache: a retry attempt should pay seconds, not
-        # the ~20-40 s cold compile, for programs attempt 1 already built
-        import jax
+    # persistent compile cache: a retry attempt should pay seconds, not the
+    # ~20-40 s cold compile, for programs attempt 1 already built (the cache
+    # setup itself lives in pin_platform, shared by every harness)
+    from matcha_tpu.utils import pin_platform
 
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # noqa: BLE001 — cache is best-effort
-        pass
+    pin_platform(None)
     sched, x, steps, dim = build(args)
     n = x.shape[0]
 
@@ -292,7 +289,7 @@ def worker_main(args) -> int:
             try:
                 sweep[bd] = time_backend("fused", sched, x, steps, args.dtype,
                                          chunk=1, block_d=bd,
-                                         w_window=args.w_window)
+                                         w_window=args.w_window, reps=5)
             except Exception as e:  # noqa: BLE001
                 print(f"# block_d={bd} failed: {type(e).__name__}: "
                       f"{str(e)[:200]}", file=sys.stderr)
@@ -306,21 +303,52 @@ def worker_main(args) -> int:
         block_d = args.block_d
         per_step = time_backend("fused", sched, x, steps, args.dtype,
                                 chunk=1, block_d=block_d,
-                                w_window=args.w_window)
+                                w_window=args.w_window, reps=5)
 
-    record = {
-        "metric": f"per-step gossip-steps/sec @ {n} virtual workers, "
-                  f"D={dim} (ResNet-20), MATCHA budget 0.5, {args.dtype}",
-        "value": round(per_step, 1),
-        "unit": "gossip_steps_per_sec",
-        "vs_baseline": round(per_step / NORTH_STAR, 4),
-        "backend": "fused",
-        "chunk": 1,
-        "block_d": block_d,
-        "w_window": args.w_window,
-    }
-    record.update(roofline("fused", per_step, n, dim, args.dtype,
-                           block_d=block_d, chunk=1))
+    def _make_record(value, w_win):
+        return {
+            "metric": f"per-step gossip-steps/sec @ {n} virtual workers, "
+                      f"D={dim} (ResNet-20), MATCHA budget 0.5, {args.dtype}",
+            "value": round(value, 1), "unit": "gossip_steps_per_sec",
+            "vs_baseline": round(value / NORTH_STAR, 4), "backend": "fused",
+            "chunk": 1, "block_d": block_d, "w_window": w_win,
+            **roofline("fused", value, n, dim, args.dtype,
+                       block_d=block_d, chunk=1),
+        }
+
+    # flush the pre-sweep record the moment it exists: the parent salvages
+    # the last complete JSON line if the attempt clock dies mid-sweep
+    print(json.dumps(_make_record(per_step, args.w_window)))
+    sys.stdout.flush()
+
+    # small w_window autotune: the winner drifts with window conditions (a
+    # contended chip favors different grid/DMA granularity than a quiet one —
+    # r4 live sessions measured both 5,005.7 at w=8 and 4,461±110 at the same
+    # config hours apart).  Same per-step arithmetic at every candidate, so
+    # this is tuning, not a metric change.  Early-exit on reaching the north
+    # star keeps the attempt inside its wall-clock bound; compiles beyond the
+    # first are warm via the persistent cache.
+    w_window = args.w_window
+    if args.w_sweep:
+        # tolerate sloppy lists ("4,16," / "4,,16"): a malformed flag must
+        # not become a deterministic worker crash that burns every retry
+        cands = [int(w) for w in args.w_sweep.split(",") if w.strip().isdigit()]
+        for cand in cands:
+            if cand <= 0 or cand == args.w_window or per_step >= NORTH_STAR:
+                continue
+            try:
+                v = time_backend("fused", sched, x, steps, args.dtype,
+                                 chunk=1, block_d=block_d,
+                                 w_window=cand, reps=5)
+            except Exception as e:  # noqa: BLE001
+                print(f"# w_window={cand} failed: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+                continue
+            print(f"# w_window={cand}: {v:.1f}", file=sys.stderr)
+            if v > per_step:
+                per_step, w_window = v, cand
+
+    record = _make_record(per_step, w_window)
     # print the primary the moment it exists: if the chunked secondary (or
     # the attempt clock) dies, the parent salvages this line from partial
     # stdout instead of losing the TPU number (r4 postmortem)
@@ -505,8 +533,14 @@ def main():
                         "per-step kernel; exact per-step arithmetic (unlike "
                         "--chunk) — amortizes grid overhead and batches W "
                         "DMAs. Default 8 = the r4 v5e sweep winner "
-                        "(5005.7 steps/s with block_d 4096, 91% MFU; "
+                        "(5005.7 steps/s with block_d 4096, 91%% MFU; "
                         "window 32 regresses to 4512)")
+    p.add_argument("--w-sweep", default="4,16",
+                   help="comma-separated extra w_window candidates the "
+                        "per-step primary tries after --w-window, keeping "
+                        "the best rate (early-exits once the north star is "
+                        "reached; identical per-step arithmetic at every "
+                        "candidate). Empty string disables.")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=240.0,
                    help="wall-clock bound per TPU measurement attempt (s)")
@@ -547,7 +581,8 @@ def main():
                     "--steps", str(args.steps), "--workers", str(args.workers),
                     "--chunk", str(args.chunk), "--block-d", str(args.block_d),
                     "--chunk-block-d", str(args.chunk_block_d),
-                    "--w-window", str(args.w_window)]
+                    "--w-window", str(args.w_window),
+                    "--w-sweep", args.w_sweep]
     return orchestrate(args, passthrough)
 
 

@@ -50,6 +50,8 @@ def measure(args) -> dict:
 
     n, b = args.workers, args.batch
     model = ResNet(depth=20, num_classes=10, remat=args.remat)
+    print(f"# [{time.strftime('%H:%M:%S')}] building {n}-worker schedule "
+          f"(CVX solve ~60-90s at 256)...", file=sys.stderr, flush=True)
     edges = tp.make_graph("geometric", n, seed=1)
     dec = tp.decompose(edges, n, seed=1)
     # every chain_j(state) rep restarts from the same initial state (and
@@ -64,10 +66,20 @@ def measure(args) -> dict:
     yb = jnp.asarray(rng.integers(0, 10, size=(n, b)).astype(np.int32))
     key = jax.random.PRNGKey(0)
 
+    def log(msg):
+        # stage-by-stage wall-clock breadcrumbs on stderr: a timed-out
+        # tunneled run must show WHERE the budget went (transfer? init
+        # compile? chain compile?) instead of dying silently
+        print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+              flush=True)
+
     def steps_per_sec(comm_name: str) -> float:
         comm = select_communicator(comm_name, sched)
+        log(f"{comm_name}: init_train_state...")
         state, flattener = init_train_state(
             model, (32, 32, 3), n, optimizer, comm, seed=0)
+        jax.block_until_ready(state.params)
+        log(f"{comm_name}: init done; compiling {args.steps}-step chain...")
         step = make_train_step(model, optimizer, comm, flattener, sched.flags,
                                lr_schedule=lr,
                                grad_chunk=args.grad_chunk or None)
@@ -82,14 +94,19 @@ def measure(args) -> dict:
         # block_until_ready alone can return early — see bench.py)
         out_state, m = chain_j(state)
         float(m["loss"])
+        log(f"{comm_name}: chain compiled + warm; timing {args.reps} reps...")
         best = float("inf")
         for _ in range(args.reps):
             t0 = time.perf_counter()
             _, m = chain_j(state)
             float(m["loss"])
             best = min(best, time.perf_counter() - t0)
+        log(f"{comm_name}: {args.steps / best:.2f} steps/s")
         return args.steps / best
 
+    log(f"data on device: x {xb.shape} {xb.nbytes >> 20} MiB...")
+    jax.block_until_ready(xb)
+    log("data transferred; schedule built")
     rate_full = steps_per_sec("decen")
     rate_none = steps_per_sec("none")
 

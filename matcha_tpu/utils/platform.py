@@ -22,8 +22,16 @@ def pin_platform(name: Optional[str]) -> None:
     the first ``jax.devices()``/jit — jax.config cannot retarget an
     initialized backend.
     """
-    if not name:
-        return
     import jax
 
+    try:
+        # persistent compile cache, shared across every harness entry point:
+        # a retried attempt on the flaky tunnel should pay seconds, not the
+        # multi-minute XLA build, for programs an earlier attempt compiled
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is best-effort
+        pass
+    if not name:
+        return
     jax.config.update("jax_platforms", name)
