@@ -16,32 +16,44 @@ set -x
 #    failed/timed-out gate must NOT abort before bench.py — the bench
 #    self-protects and always emits a structured artifact (its CPU
 #    provisional); the gate only gates the *expensive tuning* steps below.
-timeout 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_RC=$?
+timeout -k 30 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_RC=$?
 
 # 1. THE driver artifact: per-step primary + chunked secondary (≤ ~9 min);
 #    runs even on a broken tunnel (bounded attempts + CPU provisional)
 python bench.py
 [ "$GATE_RC" -eq 0 ] || { echo "gate failed (rc=$GATE_RC): skipping tuning steps"; exit 1; }
 
+# Every step below is timeout-wrapped: the tunnel's observed failure mode
+# (r4) is a mid-RPC stall that hangs the client forever — an unwrapped step
+# would wedge the whole session on the first stall and lose the later steps.
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
-#    (--remat: the un-rematted 256x32 backward over-allocates v5e HBM)
-python benchmarks/train_step_bench.py --remat --out benchmarks/train_step_bench.json
+#    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
+#    HBM).  Generous bound: the program compiles are the cost; they persist
+#    in the compile cache, so even a timed-out attempt pays forward.
+timeout -k 30 1500 python benchmarks/train_step_bench.py --remat --grad-chunk 32 \
+    --out benchmarks/train_step_bench.json
 
 # 3. converge tier, highest-value configs first: the 256-images-per-worker
 #    CHOCO rerun of config 4 (VERDICT r3 item 3 — the 64-image-shard CPU
 #    probes plateaued; see baselines_converge.jsonl), then configs 2/3
 #    (VERDICT r3 item 4), then the rest.  One invocation per config so a
 #    dying tunnel loses at most the in-flight run.
+#    Budgets: the CPU-measured converge runs took 5,000-8,100 s (64w
+#    configs); on TPU the epochs collapse but the compile is the cost, so
+#    each config gets an hour (the run_baselines SIGTERM handler records an
+#    explicit error line if the budget still isn't enough) and -k guarantees
+#    a KILL if the tunnel stall leaves the client unkillable-by-TERM.
 for c in choco-resnet-cifar10-64w matcha-vgg16-cifar10-8w \
          matcha-wrn-cifar100-16w dpsgd-resnet-cifar10-8w \
-         matcha-resnet50-imagenet-256w; do
-    python benchmarks/run_baselines.py --scale converge --only "$c" \
-        --out benchmarks/baselines_converge.jsonl
+         matcha-resnet50-imagenet-256w matcha-mlp-digits-8w; do
+    timeout -k 30 3600 python benchmarks/run_baselines.py --scale converge \
+        --only "$c" --out benchmarks/baselines_converge.jsonl
 done
 
 # 4. regenerate the timing artifacts with reps/noise bands
-python benchmarks/time_to_acc.py --reps 2
-python benchmarks/budget_sweep.py --reps 2
+timeout -k 30 1200 python benchmarks/time_to_acc.py --reps 2
+timeout -k 30 1200 python benchmarks/budget_sweep.py --reps 2
 
 # 5. refresh the skip microbench (masked-control discipline)
-python benchmarks/skip_microbench.py
+timeout -k 30 600 python benchmarks/skip_microbench.py

@@ -10,6 +10,7 @@ from .datasets import (
     normalized_zero,
     synthetic_classification,
     synthetic_images,
+    uci_digits,
 )
 from .partition import (
     partition_fractions,
@@ -32,4 +33,5 @@ __all__ = [
     "partition_uniform",
     "synthetic_classification",
     "synthetic_images",
+    "uci_digits",
 ]

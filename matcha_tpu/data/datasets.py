@@ -23,6 +23,7 @@ __all__ = [
     "Dataset",
     "synthetic_classification",
     "synthetic_images",
+    "uci_digits",
     "load_npz",
     "normalize",
     "augment_crop_flip",
@@ -36,6 +37,10 @@ NORMALIZATION = {
     "cifar100": ((0.5071, 0.4867, 0.4408), (0.2675, 0.2565, 0.2761)),
     "imagenet": ((0.485, 0.456, 0.406), (0.229, 0.224, 0.225)),
     "emnist": ((0.1307,), (0.3081,)),
+    # UCI handwritten digits (scikit-learn's bundled copy), constants over
+    # the full 1,797-image set after the /16 range scale — fixed like the
+    # torchvision-style constants above, not recomputed per split
+    "digits": ((0.3053,), (0.376,)),
 }
 
 
@@ -93,6 +98,37 @@ def synthetic_images(
     ds = synthetic_classification(num_train, num_test, (32, 32, 3), 10, seed,
                                   separation=separation)
     return dataclasses.replace(ds, name="synthetic_image")
+
+
+def uci_digits(num_test: int = 360, seed: int = 0) -> Dataset:
+    """Real handwritten-digit pixels, fully offline: scikit-learn's bundled
+    UCI ML handwritten digits (1,797 8×8 grayscale images, 10 classes).
+
+    This is the real-pixel stand-in for the reference's EMNIST/MLP
+    configuration (util.py:165-254 builds EMNIST loaders; select_model maps
+    ``mlp`` to the 784-500-500 net, util.py:267-268): the environment has no
+    network egress and no torchvision, so EMNIST itself cannot be fetched —
+    these are the only real image pixels shipped inside the image's baked
+    packages.  Pixels are scaled to [0, 1] (the range ToTensor() gives the
+    reference's transforms) and standardized with the fixed ``digits``
+    constants; the train/test split is a seeded permutation, deterministic
+    for a given ``(num_test, seed)``.
+    """
+    from sklearn.datasets import load_digits  # baked into the image
+
+    d = load_digits()
+    x = (d.images.astype(np.float32) / 16.0)[..., None]  # [1797, 8, 8, 1]
+    y = d.target.astype(np.int32)
+    if not 0 < num_test < len(y):
+        raise ValueError(
+            f"num_test={num_test} must leave both splits non-empty "
+            f"(dataset has {len(y)} images)"
+        )
+    mean, std = NORMALIZATION["digits"]
+    x = (x - np.float32(mean[0])) / np.float32(std[0])
+    order = np.random.default_rng(seed).permutation(len(y))
+    test, train = order[:num_test], order[num_test:]
+    return Dataset(x[train], y[train], x[test], y[test], 10, name="digits")
 
 
 def load_npz(path: str, dataset: str = "cifar10", num_classes: int | None = None) -> Dataset:

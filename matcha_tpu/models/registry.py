@@ -29,6 +29,7 @@ DATASET_CLASSES = {
     "cifar100": 100,
     "imagenet": 1000,
     "emnist": 47,
+    "digits": 10,
     "synthetic": 10,
     "synthetic_image": 10,
 }
@@ -38,6 +39,7 @@ DATASET_SHAPES = {
     "cifar100": (32, 32, 3),
     "imagenet": (224, 224, 3),
     "emnist": (28, 28, 1),
+    "digits": (8, 8, 1),
     "synthetic": (28, 28, 1),
     "synthetic_image": (32, 32, 3),
 }

@@ -34,6 +34,7 @@ from ..data import (
     partition_indices,
     synthetic_classification,
     synthetic_images,
+    uci_digits,
 )
 from ..models import dataset_input_shape, select_model
 from ..parallel import shard_workers, worker_mesh
@@ -92,6 +93,8 @@ def build_dataset(config: TrainConfig):
         return synthetic_classification(seed=config.seed, **kwargs)
     if config.dataset == "synthetic_image":
         return synthetic_images(seed=config.seed, **kwargs)
+    if config.dataset == "digits":
+        return uci_digits(seed=config.seed, **kwargs)
     if config.datasetRoot is None:
         raise ValueError(
             f"dataset '{config.dataset}' needs datasetRoot pointing at an .npz "
