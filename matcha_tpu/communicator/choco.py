@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops import scatter_rows, select_compressor
+from ..ops import DETERMINISTIC_COMPRESSORS, scatter_rows, select_compressor
 from ..schedule import Schedule
 from .base import Communicator
 
@@ -92,13 +92,15 @@ def make_choco(
     train_mpi.py:228).  ``backend``: ``batched`` | ``shard_map`` | ``auto``
     (shard_map when a multi-device ``mesh`` is given).
 
-    ``compressor`` selects from the ops registry (``top_k`` | ``random_k`` |
-    ``top_k_q8``) — the extension point the reference reserves next to top-k
+    ``compressor`` selects from the ops registry (``COMPRESSOR_NAMES``:
+    ``top_k`` | ``random_k`` | ``top_k_q8`` | ``top_k_approx``) — the
+    extension point the reference reserves next to top-k
     (communicator.py:186-187).  The stochastic compressors thread a PRNG key
     through the carry (seeded by ``seed``), so runs stay reproducible and the
     whole chain remains one compiled program.  Note the batched and shard_map
     backends draw *different* key streams (per-array vs per-chip fold-in):
-    bit-parity across backends holds only for the deterministic ``top_k``.
+    bit-parity across backends holds only for the ``DETERMINISTIC_COMPRESSORS``
+    (``top_k``, ``top_k_approx``), which carry no key at all.
     """
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
@@ -107,7 +109,7 @@ def make_choco(
     partnered = (perms != np.arange(N)[None, :]).astype(np.float32)  # [M, N]
     nonempty = [bool(partnered[j].any()) for j in range(M)]
     compress = select_compressor(compressor)
-    stochastic = compressor != "top_k"
+    stochastic = compressor not in DETERMINISTIC_COMPRESSORS
     cname = f"choco[r{ratio}" + ("" if compressor == "top_k" else f",{compressor}")
 
     if backend == "auto":

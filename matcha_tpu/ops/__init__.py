@@ -2,8 +2,10 @@
 
 from .compress import (
     COMPRESSOR_NAMES,
+    DETERMINISTIC_COMPRESSORS,
     batched_random_k,
     batched_top_k,
+    batched_top_k_approx,
     batched_top_k_q8,
     quantize_stochastic,
     dense_from_sparse,
@@ -15,9 +17,11 @@ from .flatten import WorkerFlattener, make_flattener
 
 __all__ = [
     "COMPRESSOR_NAMES",
+    "DETERMINISTIC_COMPRESSORS",
     "WorkerFlattener",
     "batched_random_k",
     "batched_top_k",
+    "batched_top_k_approx",
     "batched_top_k_q8",
     "quantize_stochastic",
     "dense_from_sparse",

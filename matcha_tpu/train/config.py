@@ -57,7 +57,7 @@ class TrainConfig:
     # communicator (reference: --compress/--consensus_lr; ratio was hard-coded)
     communicator: str = "decen"  # decen|choco|centralized|none
     compress_ratio: float = 0.9
-    compressor: str = "top_k"  # choco message compressor: top_k|random_k|top_k_q8
+    compressor: str = "top_k"  # choco message compressor (ops.COMPRESSOR_NAMES)
     consensus_lr: float = 0.1
     gossip_backend: str = "auto"  # fused|dense|gather|skip|shard_map|auto
     gossip_block_d: Optional[int] = None  # fused kernel D-block (None = default)

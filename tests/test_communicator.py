@@ -352,3 +352,22 @@ def test_fused_knobs_warn_on_other_backends():
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         make_decen(sched, backend="fused", w_window=4, block_d=512)
+
+
+def test_choco_approx_topk_contracts():
+    """CHOCO with the TPU-native approximate top-k (``top_k_approx``): the
+    compressor is deterministic (no PRNG carry needed) and still a
+    δ-contraction, so consensus must contract exactly like exact top-k's
+    path — the registry entry exists for the TPU encode-cost regime
+    (lax.approx_max_k's PartialReduce lowering vs full-sort lax.top_k)."""
+    from matcha_tpu.parallel import worker_disagreement
+
+    sched = fixed_schedule(tp.select_graph(5), 8, iterations=400)
+    comm = make_choco(sched, ratio=0.7, consensus_lr=0.3,
+                      compressor="top_k_approx")
+    x0 = jnp.asarray(random_state(8, 30, seed=1))
+    xT, _ = jax.jit(comm.run)(x0, sched.flags)
+    assert float(worker_disagreement(xT)) < 0.05 * float(worker_disagreement(x0))
+    # deterministic: rerun is bit-identical
+    xT2, _ = jax.jit(comm.run)(x0, sched.flags)
+    np.testing.assert_array_equal(np.asarray(xT), np.asarray(xT2))

@@ -141,3 +141,34 @@ def test_top_k_q8_registry_and_selection():
     # quantized payload stays within one 8-bit level of the selected values
     scale = np.abs(np.asarray(ref_vals)).max(axis=-1, keepdims=True)
     assert np.abs(np.asarray(vals) - np.asarray(ref_vals)).max() <= (scale / 255).max() + 1e-6
+
+
+def test_top_k_approx_registry_and_contraction():
+    """``top_k_approx`` (jax.lax.approx_max_k — the TPU-native PartialReduce
+    lowering): same (x, ratio, key) registry signature, k entries selected by
+    magnitude, and at least the δ-contraction CHOCO's theory needs — checked
+    against the exact top-k's energy capture at a 5% recall slack."""
+    from matcha_tpu.ops import batched_top_k_approx, select_compressor
+
+    assert select_compressor("top_k_approx") is batched_top_k_approx
+    x = jnp.asarray(np.random.default_rng(7).normal(size=(4, 257)), jnp.float32)
+    vals, idx = batched_top_k_approx(x, ratio=0.8, key=None)
+    k = max(1, int(257 * 0.2))
+    assert vals.shape == (4, k) and idx.shape == (4, k)
+    assert idx.dtype == jnp.int32
+    # selected values are the original entries at the selected coordinates
+    np.testing.assert_array_equal(
+        np.asarray(vals), np.take_along_axis(np.asarray(x), np.asarray(idx), -1))
+    # indices are distinct per row (a valid sparsification support)
+    for row in np.asarray(idx):
+        assert len(set(row.tolist())) == k
+    # energy-capture floor at the 5% recall slack.  NOTE: on CPU (this
+    # suite) approx_max_k falls back to exact top-k, so this bound is loose
+    # here by construction — the real approximation quality is measured
+    # on-device by benchmarks/encode_bench.py (approx_recall_vs_exact /
+    # approx_energy_capture_vs_exact fields), not by this unit test.
+    exact_vals, _ = batched_top_k(x, ratio=0.8)
+    k95 = int(np.ceil(0.95 * k))
+    exact95 = np.sort(np.abs(np.asarray(exact_vals)), axis=-1)[:, -k95:]
+    assert (np.sum(np.asarray(vals) ** 2, -1)
+            >= np.sum(exact95 ** 2, -1) - 1e-5).all()
