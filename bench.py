@@ -490,10 +490,32 @@ def orchestrate(args, passthrough) -> int:
         print(json.dumps(salvaged))
         return 0
 
-    # The TPU never produced a number: promote the provisional record.
+    # The TPU never produced a number: promote the provisional record, and
+    # point at the most recent *committed* live-window measurement so the
+    # fallback still carries the hardware evidence trail (the live artifact
+    # is the same `python bench.py` line, captured when the tunnel was up —
+    # see benchmarks/bench_live_r4.json).
     provisional.pop("provisional", None)
     provisional["error"] = "tpu_backend_unavailable"
     provisional["tpu_attempts"] = attempts
+    try:
+        import glob
+
+        bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks")
+        live = sorted(glob.glob(os.path.join(bench_dir, "bench_live_r*.json")))
+        if live:
+            with open(live[-1]) as f:
+                rec = json.load(f).get("record", {})
+            provisional["last_live_artifact"] = {
+                "path": f"benchmarks/{os.path.basename(live[-1])}",
+                "value": rec.get("value"),
+                "vs_baseline": rec.get("vs_baseline"),
+                "device_kind": rec.get("device_kind"),
+                "mfu": rec.get("mfu"),
+            }
+    except Exception:  # noqa: BLE001 — the pointer is best-effort context
+        pass
     print(json.dumps(provisional))
     return 0
 

@@ -34,6 +34,9 @@ def main() -> int:
     p.add_argument("--out", default=None)
     p.add_argument("--reps", type=int, default=5)
     args = p.parse_args()
+    if args.reps < 1:
+        p.error("--reps must be >= 1 (best-of-0 would emit Infinity, "
+                "which is not valid JSON)")
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from matcha_tpu.utils import pin_platform

@@ -27,21 +27,21 @@ python bench.py
 # (r4) is a mid-RPC stall that hangs the client forever — an unwrapped step
 # would wedge the whole session on the first stall and lose the later steps.
 
-# 1.5 kernel-scheduling probe: can the per-step cast overlap the MXU via
-#     column splitting? (candidate for closing the last ~9% to the per-step
-#     ceiling — integrate into pallas_gossip only if this measures a win)
-timeout -k 30 420 python benchmarks/split_probe.py --out benchmarks/split_probe.json
-
-# 1.6 CHOCO encode cost: exact vs TPU-native approximate top-k (and the
-#     other registry compressors) at the config-4 shape
-timeout -k 30 420 python benchmarks/encode_bench.py --out benchmarks/encode_bench.json
-
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist
 #    in the compile cache, so even a timed-out attempt pays forward.
 timeout -k 30 1500 python benchmarks/train_step_bench.py --remat --grad-chunk 32 \
     --out benchmarks/train_step_bench.json
+
+# 2.5 kernel-scheduling probe (after the headline: a probe stall must not cost step 2): can the per-step cast overlap the MXU via
+#     column splitting? (candidate for closing the last ~9% to the per-step
+#     ceiling — integrate into pallas_gossip only if this measures a win)
+timeout -k 30 420 python benchmarks/split_probe.py --out benchmarks/split_probe.json
+
+# 2.6 CHOCO encode cost: exact vs TPU-native approximate top-k (and the
+#     other registry compressors) at the config-4 shape
+timeout -k 30 420 python benchmarks/encode_bench.py --out benchmarks/encode_bench.json
 
 # 3. converge tier, highest-value configs first: the 256-images-per-worker
 #    CHOCO rerun of config 4 (VERDICT r3 item 3 — the 64-image-shard CPU
