@@ -503,7 +503,13 @@ def orchestrate(args, passthrough) -> int:
 
         bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "benchmarks")
-        live = sorted(glob.glob(os.path.join(bench_dir, "bench_live_r*.json")))
+        def _round_no(path):
+            # numeric suffix sort: lexicographic would rank r10 < r4
+            stem = os.path.basename(path)[len("bench_live_r"):-len(".json")]
+            return int(stem) if stem.isdigit() else -1
+
+        live = sorted(glob.glob(os.path.join(bench_dir, "bench_live_r*.json")),
+                      key=_round_no)
         if live:
             with open(live[-1]) as f:
                 rec = json.load(f).get("record", {})
@@ -583,12 +589,19 @@ def main():
     p.add_argument("--in-process", action="store_true",
                    help="run the measurement in this process (no subprocess "
                         "shield); used internally for the worker")
+    p.add_argument("--force-attempt-failure", action="store_true",
+                   help=argparse.SUPPRESS)  # test hook: worker exits 3
+                   # before touching any backend, so the orchestrator's
+                   # attempt-trail/retry/fallback path is exercisable
+                   # deterministically (tests/test_bench_contract.py)
     p.add_argument("--force-cpu", action="store_true",
                    help="pin the worker to the CPU backend via jax.config "
                         "before any backend init (the CPU-fallback path)")
     args, _ = p.parse_known_args()
 
     if args.in_process:
+        if args.force_attempt_failure:
+            return 3  # deterministic attempt failure (see --help SUPPRESS)
         if args.force_cpu:
             import jax
 
@@ -605,6 +618,8 @@ def main():
                     "--chunk-block-d", str(args.chunk_block_d),
                     "--w-window", str(args.w_window),
                     "--w-sweep", args.w_sweep]
+    if args.force_attempt_failure:  # test hook rides only the TPU attempts;
+        passthrough.append("--force-attempt-failure")  # the provisional stays real
     return orchestrate(args, passthrough)
 
 
