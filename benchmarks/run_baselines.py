@@ -141,9 +141,14 @@ CONVERGE_OVERRIDES = {
     # reaches 0.97): two probes with 64-image shards plateaued at ~0.26
     # regardless of step count (10ep/batch8 = 80 steps and 24ep/batch4 =
     # 384 steps), so the shard size, not the step budget, was the limit.
+    # consensus_lr: γ=0.3 with 256-image shards rose to 0.68 by epoch 5 and
+    # then DECAYED to 0.44 (r4 committed line — consensus instability
+    # compounding at 64 workers; both r3 γ=0.1 probes were stable, merely
+    # data-starved), so γ backs off to the reference default 0.1 and the
+    # horizon stretches to 12 epochs for the slower-but-stable consensus.
     # The smaller test set keeps single-core eval FLOPs from dominating.
     "choco-resnet-cifar10-64w": dict(
-        _CONVERGE_DATA, epochs=10, consensus_lr=0.3,
+        _CONVERGE_DATA, epochs=12, consensus_lr=0.1,
         dataset_kwargs={"num_train": 16384, "num_test": 256,
                         "separation": 40.0}),
     # 256 workers x 224x224 ResNet-50: remat + 32-worker fwd/bwd slabs keep
