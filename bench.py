@@ -130,7 +130,14 @@ def _cached_schedule(n, steps):
     from matcha_tpu import topology as tp
     from matcha_tpu.schedule import matcha_schedule, Schedule
 
-    cache = f"/tmp/matcha_bench_sched_geometric_n{n}_b0.5_s{steps}_seed0.npz"
+    # per-user path (same reasoning as platform._cache_dir, ADVICE r4): a
+    # world-shared /tmp name is poisonable and os.replace over another
+    # user's file raises in sticky /tmp
+    import tempfile
+    uid = os.getuid() if hasattr(os, "getuid") else "na"
+    cache = os.path.join(
+        tempfile.gettempdir(),
+        f"matcha_bench_u{uid}_sched_geometric_n{n}_b0.5_s{steps}_seed0.npz")
     if os.path.exists(cache):
         try:
             z = np.load(cache)
@@ -161,7 +168,7 @@ def _cached_schedule(n, steps):
 
 
 def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
-                 w_window=1, reps=3):
+                 w_window=1, reps=3, return_rates=False):
     import jax
     import jax.numpy as jnp
 
@@ -191,12 +198,14 @@ def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
     # whole chain (every output column depends on all T steps).
     run = jax.jit(lambda x: jnp.sum(comm.run(x, flags)[0][:, :8].astype(jnp.float32)))
     float(run(x))  # compile + warmup, forced to completion
-    best = float("inf")
+    rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
         float(run(x))
-        best = min(best, time.perf_counter() - t0)
-    return steps / best
+        rates.append(steps / (time.perf_counter() - t0))
+    if return_rates:
+        return max(rates), rates
+    return max(rates)
 
 
 def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1):
@@ -252,6 +261,16 @@ def worker_main(args) -> int:
     pin_platform(None)
     sched, x, steps, dim = build(args)
     n = x.shape[0]
+    # absolute wall-clock deadline handed down by the orchestrator (0 = none):
+    # optional refinements (sweep candidates, chunked secondary) are skipped
+    # once the attempt clock is nearly spent, so the primary record that is
+    # already flushed survives instead of being SIGKILLed mid-refinement
+    # (ADVICE r4: a cold-cache sweep candidate could push the attempt into
+    # its timeout)
+    deadline = args.deadline or float("inf")
+
+    def time_left():
+        return deadline - time.time()
 
     if args.backend != "fused":
         # single-backend mode (diagnostics): time it per-step and report
@@ -289,28 +308,35 @@ def worker_main(args) -> int:
             try:
                 sweep[bd] = time_backend("fused", sched, x, steps, args.dtype,
                                          chunk=1, block_d=bd,
-                                         w_window=args.w_window, reps=5)
+                                         w_window=args.w_window, reps=5,
+                                         return_rates=True)
             except Exception as e:  # noqa: BLE001
                 print(f"# block_d={bd} failed: {type(e).__name__}: "
                       f"{str(e)[:200]}", file=sys.stderr)
         if not sweep:
             raise RuntimeError("no block_d candidate compiled")
-        block_d = max(sweep, key=sweep.get)
-        per_step = sweep[block_d]
-        print(f"# block_d sweep: { {b: round(v, 1) for b, v in sweep.items()} } "
+        block_d = max(sweep, key=lambda b: sweep[b][0])
+        per_step, trials = sweep[block_d]
+        print(f"# block_d sweep: { {b: round(v[0], 1) for b, v in sweep.items()} } "
               f"-> {block_d}", file=sys.stderr)
     else:
         block_d = args.block_d
-        per_step = time_backend("fused", sched, x, steps, args.dtype,
-                                chunk=1, block_d=block_d,
-                                w_window=args.w_window, reps=5)
+        per_step, trials = time_backend("fused", sched, x, steps, args.dtype,
+                                        chunk=1, block_d=block_d,
+                                        w_window=args.w_window, reps=5,
+                                        return_rates=True)
 
-    def _make_record(value, w_win):
+    def _make_record(value, w_win, rates):
         return {
             "metric": f"per-step gossip-steps/sec @ {n} virtual workers, "
                       f"D={dim} (ResNet-20), MATCHA budget 0.5, {args.dtype}",
             "value": round(value, 1), "unit": "gossip_steps_per_sec",
             "vs_baseline": round(value / NORTH_STAR, 4), "backend": "fused",
+            # the trial spread travels in the primary record (ROOFLINE.md
+            # staged mitigation: vs_baseline must carry its uncertainty) —
+            # value is best-of-reps; stddev/trials show the window's noise
+            "value_stddev": round(float(np.std(rates)), 1),
+            "value_trials": [round(r, 1) for r in rates],
             "chunk": 1, "block_d": block_d, "w_window": w_win,
             **roofline("fused", value, n, dim, args.dtype,
                        block_d=block_d, chunk=1),
@@ -318,7 +344,7 @@ def worker_main(args) -> int:
 
     # flush the pre-sweep record the moment it exists: the parent salvages
     # the last complete JSON line if the attempt clock dies mid-sweep
-    print(json.dumps(_make_record(per_step, args.w_window)))
+    print(json.dumps(_make_record(per_step, args.w_window, trials)))
     sys.stdout.flush()
 
     # small w_window autotune: the winner drifts with window conditions (a
@@ -336,19 +362,26 @@ def worker_main(args) -> int:
         for cand in cands:
             if cand <= 0 or cand == args.w_window or per_step >= NORTH_STAR:
                 continue
+            if time_left() < 60.0:
+                # a candidate costs a (possibly cold) compile + 5 reps; with
+                # the attempt clock nearly spent, keep the flushed primary
+                # instead of gambling it on a refinement (ADVICE r4)
+                print(f"# w_sweep stopped: {time_left():.0f}s left",
+                      file=sys.stderr)
+                break
             try:
-                v = time_backend("fused", sched, x, steps, args.dtype,
-                                 chunk=1, block_d=block_d,
-                                 w_window=cand, reps=5)
+                v, r = time_backend("fused", sched, x, steps, args.dtype,
+                                    chunk=1, block_d=block_d,
+                                    w_window=cand, reps=5, return_rates=True)
             except Exception as e:  # noqa: BLE001
                 print(f"# w_window={cand} failed: {type(e).__name__}: "
                       f"{str(e)[:200]}", file=sys.stderr)
                 continue
             print(f"# w_window={cand}: {v:.1f}", file=sys.stderr)
             if v > per_step:
-                per_step, w_window = v, cand
+                per_step, w_window, trials = v, cand, r
 
-    record = _make_record(per_step, w_window)
+    record = _make_record(per_step, w_window, trials)
     # print the primary the moment it exists: if the chunked secondary (or
     # the attempt clock) dies, the parent salvages this line from partial
     # stdout instead of losing the TPU number (r4 postmortem)
@@ -356,7 +389,10 @@ def worker_main(args) -> int:
     sys.stdout.flush()
 
     # --- secondary: chunked chain composition (consensus-only regime) ------
-    if args.chunk > 1:
+    if args.chunk > 1 and time_left() < 45.0:
+        print(f"# chunked secondary skipped: {time_left():.0f}s left",
+              file=sys.stderr)
+    elif args.chunk > 1:
         from matcha_tpu.parallel import canonical_chunk
 
         chunk = canonical_chunk(args.chunk)
@@ -448,21 +484,70 @@ def orchestrate(args, passthrough) -> int:
     print(f"# provisional (cpu) done in {secs:.0f}s; "
           f"{budget_left():.0f}s budget left", file=sys.stderr)
 
+    # Phase 1.5 — fast dead-tunnel probe (r4 postmortem: both 240 s attempts
+    # hung in backend init against a dead tunnel, burning the whole budget for
+    # nothing).  A bounded `jax.devices()` subprocess answers "is the tunnel
+    # worth a full attempt?" in ≤ --probe-timeout; when it says dead, one more
+    # probe after a short pause covers a mid-run revival, then the attempts
+    # are skipped entirely and the fallback (with its live-artifact pointer)
+    # prints minutes earlier.  The probe is skipped for the deterministic
+    # test hook (no backend is touched there).
+    probes = []
+    tunnel_alive = args.force_attempt_failure or args.probe_timeout <= 0
+    if not tunnel_alive:
+        # "alive" means the backend ANSWERS — any device kind.  The tunnel's
+        # failure mode is a hang inside backend init, so a fast answer (even
+        # a CPU-only dev host) proves the attempts won't wedge; asserting on
+        # the kind here would wrongly disable measurement on non-TPU hosts.
+        probe_cmd = [
+            sys.executable, "-c",
+            "import jax; print(jax.devices()[0].device_kind)",
+        ]
+        for p in range(2):
+            # a probe must never eat the budget of the one attempt it is
+            # meant to protect: reserve the minimum viable attempt (60 s) +
+            # the parent slack (20 s) + 20 s margin for the probe→attempt
+            # transition = 100 s before spending on a probe, and when there
+            # isn't room for that, just attempt — the old behavior — rather
+            # than budget-skip with an empty trail
+            t = min(args.probe_timeout, budget_left() - 100.0)
+            if t < 15.0:
+                if not probes:
+                    tunnel_alive = True  # unprobed: give the attempt a shot
+                break
+            rc, out, err, timed_out, secs = _run_bounded(
+                probe_cmd, dict(os.environ), t)
+            probes.append({"probe": p + 1, "rc": rc, "timed_out": timed_out,
+                           "seconds": round(secs, 1),
+                           "device_kind": out.strip() if rc == 0 else None})
+            if rc == 0:
+                tunnel_alive = True
+                break
+            print(f"# tunnel probe {p+1} dead (rc={rc}, timeout={timed_out})",
+                  file=sys.stderr)
+            if p == 0 and budget_left() > args.probe_timeout + 160.0:
+                time.sleep(15.0)
+
     # Phase 2 — TPU attempts, each clipped to the remaining total budget
     # (20 s slack for parent overhead + final print).
-    cmd = [sys.executable, me, "--in-process"] + passthrough
     attempts = []
     salvaged = None  # best partial record (primary printed, secondary lost)
-    for i in range(args.retries):
+    for i in range(args.retries if tunnel_alive else 0):
         timeout = min(args.attempt_timeout, budget_left() - 20.0)
         if timeout < 60.0:
             attempts.append({"attempt": i + 1, "skipped": "budget_exhausted"})
             break
+        # the worker budgets its optional refinements against this absolute
+        # deadline (w_sweep / chunked secondary are skipped near the bound)
+        cmd = ([sys.executable, me, "--in-process",
+                "--deadline", str(time.time() + timeout)] + passthrough)
         rc, out, err, timed_out, secs = _run_bounded(cmd, dict(os.environ), timeout)
         record = _last_json_line(out)
         if rc == 0 and record is not None:
             if attempts:
                 record["retries"] = attempts
+            if probes:
+                record["tunnel_probes"] = probes
             print(json.dumps(record))
             return 0
         if record is not None and record.get("backend") != "cpu-fallback":
@@ -487,6 +572,8 @@ def orchestrate(args, passthrough) -> int:
 
     if salvaged is not None:
         salvaged["retries"] = attempts
+        if probes:
+            salvaged["tunnel_probes"] = probes
         print(json.dumps(salvaged))
         return 0
 
@@ -498,6 +585,8 @@ def orchestrate(args, passthrough) -> int:
     provisional.pop("provisional", None)
     provisional["error"] = "tpu_backend_unavailable"
     provisional["tpu_attempts"] = attempts
+    if probes:
+        provisional["tunnel_probes"] = probes
     try:
         import glob
 
@@ -572,6 +661,15 @@ def main():
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=240.0,
                    help="wall-clock bound per TPU measurement attempt (s)")
+    p.add_argument("--probe-timeout", type=float, default=75.0,
+                   help="wall-clock bound for the pre-attempt dead-tunnel "
+                        "probe (a bare jax.devices() subprocess); 0 disables "
+                        "probing and always launches the full attempts")
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help=argparse.SUPPRESS)  # absolute unix timestamp the
+                   # orchestrator hands the worker so optional refinements
+                   # (w_sweep, chunked secondary) stop before the attempt
+                   # clock kills the process; 0 = unbounded
     p.add_argument("--provisional-timeout", type=float, default=240.0,
                    help="wall-clock bound for the CPU provisional phase (s)")
     p.add_argument("--total-budget", type=float, default=540.0,

@@ -197,9 +197,12 @@ def main():
     names = list(CONFIGS) if args.only is None else args.only.split(",")
     failures = 0
 
-    # a timeout-wrapper's SIGTERM must leave a recorded error line, not a
-    # silently missing config: convert it to an exception the per-config
-    # handler below records (and flushes) before the process exits
+    # Best-effort: convert a timeout-wrapper's SIGTERM into an exception the
+    # per-config handler below records (and flushes) before the process
+    # exits.  Python only delivers the signal at a bytecode boundary — TERM
+    # arriving mid-XLA-call (the tunnel's common stall mode) stays pending
+    # until the C++ call returns, and `timeout -k` may SIGKILL first; the
+    # `started` breadcrumb printed before train() is the guaranteed trace.
     def _sigterm(signum, frame):
         raise TimeoutError("SIGTERM (outer timeout wrapper)")
 
@@ -223,6 +226,11 @@ def main():
                 cfg = dataclasses.replace(cfg, scan_epoch=False)
             t0 = time.time()
             timed_out = False
+            # stderr breadcrumb (stdout and the JSONL stay records-only: a
+            # `> results.jsonl` caller must not get comment lines): a
+            # SIGKILLed run still shows which config was in flight
+            print(f"# started {cname} ({args.scale})", file=sys.stderr,
+                  flush=True)
             try:
                 hist = train(cfg).history
             except Exception as e:  # one config failing must not eat the rest

@@ -14,7 +14,7 @@ so it is measured, not assumed.
 
 Writes ``{base, split, ratio, device_kind}`` JSON to --out; exits 0 even when
 inconclusive (the artifact records what happened).  Run it only on a live
-tunnel (tpu_session.sh step 1.5).
+tunnel (tpu_session.sh step 2.5).
 """
 
 from __future__ import annotations
@@ -106,9 +106,13 @@ def main() -> int:
            "block_d": BD, "w_window": W,
            "device_kind": jax.devices()[0].device_kind}
     try:
-        b0 = float(jnp.sum(run(x, stk)[:, :8].astype(jnp.float32)))
-        b1 = float(jnp.sum(run(x, stk, split=True)[:, :8].astype(jnp.float32)))
-        rec["slice_sums_equal"] = (b0 == b1)
+        # whole-array equality on device (ADVICE r4: the earlier 8-column
+        # f32-sum check could miss a divergence in the other 273k columns)
+        y0 = run(x, stk)
+        y1 = run(x, stk, split=True)
+        rec["outputs_equal"] = bool(jnp.array_equal(y0, y1))
+        rec["slice_sums_equal"] = rec["outputs_equal"]  # back-compat key
+        del y0, y1
         rec["base_steps_per_sec"] = round(rate(False), 1)
         rec["split_steps_per_sec"] = round(rate(True), 1)
         rec["ratio"] = round(rec["split_steps_per_sec"]

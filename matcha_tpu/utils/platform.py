@@ -10,17 +10,32 @@ hangs backend init, which is why every entry point offers ``--platform cpu``.
 
 from __future__ import annotations
 
+import os
+import tempfile
 from typing import Optional
 
 __all__ = ["pin_platform"]
 
 
+def _cache_dir() -> str:
+    """Per-user compile-cache path: a fixed shared /tmp name is writable (or
+    pre-populatable) by any user on a multi-user host (ADVICE r4).  An
+    explicit ``JAX_COMPILATION_CACHE_DIR`` wins outright."""
+    explicit = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if explicit:
+        return explicit
+    uid = os.getuid() if hasattr(os, "getuid") else "na"
+    return os.path.join(tempfile.gettempdir(), f"jax_cache_u{uid}")
+
+
 def pin_platform(name: Optional[str]) -> None:
     """Pin the JAX platform (``"cpu"``/``"tpu"``) before any backend use.
 
-    ``None`` is a no-op (keep the environment's default).  Must run before
-    the first ``jax.devices()``/jit — jax.config cannot retarget an
-    initialized backend.
+    ``None`` pins no platform (keep the environment's default) but still
+    configures the persistent compile cache — the harness entry points rely
+    on that side effect to make tunnel retries cheap.  Must run before the
+    first ``jax.devices()``/jit — jax.config cannot retarget an initialized
+    backend.
     """
     import jax
 
@@ -28,7 +43,7 @@ def pin_platform(name: Optional[str]) -> None:
         # persistent compile cache, shared across every harness entry point:
         # a retried attempt on the flaky tunnel should pay seconds, not the
         # multi-minute XLA build, for programs an earlier attempt compiled
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        jax.config.update("jax_compilation_cache_dir", _cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # noqa: BLE001 — cache is best-effort
         pass

@@ -36,9 +36,9 @@ def test_bench_fallback_record_is_structured_and_rc_zero():
     with the per-attempt failure trail and the hardware-evidence pointer."""
     proc = subprocess.run(
         [sys.executable, "bench.py", "--smoke", "--force-attempt-failure",
-         "--total-budget", "240", "--provisional-timeout", "120",
+         "--total-budget", "400", "--provisional-timeout", "120",
          "--attempt-timeout", "70", "--retries", "2"],
-        capture_output=True, text=True, timeout=420, cwd=REPO,
+        capture_output=True, text=True, timeout=560, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     rec = _last_json(proc.stdout)
@@ -51,12 +51,14 @@ def test_bench_fallback_record_is_structured_and_rc_zero():
     assert rec["value"] > 0
     assert "cpu_fallback_error" not in rec
     assert rec["error"] == "tpu_backend_unavailable"
-    # two real attempts were LAUNCHED and failed rc=3 (not budget-skipped)
+    # at least one real attempt was LAUNCHED and failed rc=3; on a loaded
+    # host a slow provisional may legitimately budget-skip the second
+    # (ADVICE r4: exact-count asserts here were spuriously load-sensitive)
     attempts = rec["tpu_attempts"]
-    assert len(attempts) == 2
-    for a in attempts:
+    launched = [a for a in attempts if "skipped" not in a]
+    assert launched, attempts
+    for a in launched:
         assert a.get("rc") == 3 and a.get("timed_out") is False
-        assert "skipped" not in a
     # the hardware evidence pointer rides the fallback: the NEWEST committed
     # bench_live_r*.json by numeric round (lexicographic would rank r10<r4)
     live = rec.get("last_live_artifact")
