@@ -50,7 +50,14 @@ def batched_top_k(
     accepted and ignored so every registry compressor shares the
     ``(x, ratio, key)`` signature (see ``DETERMINISTIC_COMPRESSORS``).
     """
-    k = top_k_ratio_size(x.shape[-1], ratio)
+    d = x.shape[-1]
+    k = top_k_ratio_size(d, ratio)
+    if k >= d:
+        # keep-all (ratio ≤ 0, e.g. a compression-warmup epoch 0): the
+        # selected set is every coordinate, so skip the O(D log D) top-k
+        # sort — identity values with arange indices, actual dense cost
+        idx = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32), x.shape)
+        return x, idx
     _, idx = jax.lax.top_k(jnp.abs(x), k)
     vals = jnp.take_along_axis(x, idx, axis=-1)
     return vals, idx.astype(jnp.int32)

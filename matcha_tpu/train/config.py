@@ -59,6 +59,15 @@ class TrainConfig:
     compress_ratio: float = 0.9
     compressor: str = "top_k"  # choco message compressor (ops.COMPRESSOR_NAMES)
     consensus_lr: float = 0.1
+    # CHOCO compression warmup: ramp the drop-ratio linearly from 0 (keep
+    # everything — dense-speed consensus while the replicas are far apart)
+    # to ``compress_ratio`` over this many epochs, then hold.  0 disables.
+    # Each distinct per-epoch ratio compiles its own step program (the top-k
+    # size is a static shape), so keep it small (≤ ~6).  The reference
+    # hard-codes ratio 0.9 for the whole run (train_mpi.py:79); the warmup
+    # addresses the compressed-consensus cold start that leaves 64-worker
+    # top-k-10% runs far behind their uncompressed control early on.
+    compress_warmup_epochs: int = 0
     gossip_backend: str = "auto"  # fused|dense|gather|skip|shard_map|auto
     gossip_block_d: Optional[int] = None  # fused kernel D-block (None = default)
     gossip_w_window: int = 1  # fused kernel W_t per D-block visit (exact)
@@ -114,3 +123,9 @@ class TrainConfig:
                 raise ValueError(
                     f"grad_chunk {self.grad_chunk} must divide "
                     f"num_workers {self.num_workers}")
+        if self.compress_warmup_epochs < 0:
+            raise ValueError("compress_warmup_epochs must be >= 0")
+        if self.compress_warmup_epochs and self.communicator != "choco":
+            raise ValueError(
+                "compress_warmup_epochs only applies to the choco "
+                "communicator (the only compressed one)")

@@ -161,10 +161,52 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if mesh is not None:
         state = shard_workers(state, mesh)
 
-    step_fn = make_train_step(
-        model, optimizer, communicator, flattener, schedule.flags,
-        dropout=False, lr_schedule=lr_schedule, grad_chunk=config.grad_chunk,
-    )
+    def _make_step(comm):
+        return make_train_step(
+            model, optimizer, comm, flattener, schedule.flags,
+            dropout=False, lr_schedule=lr_schedule,
+            grad_chunk=config.grad_chunk,
+        )
+
+    step_fn = _make_step(communicator)
+
+    # CHOCO compression warmup: epochs < compress_warmup_epochs run at a
+    # linearly ramped drop-ratio (0 at epoch 0 — dense-rate consensus while
+    # replicas are far apart — reaching compress_ratio at the warmup edge).
+    # Each distinct ratio is a different top-k size, i.e. a different static
+    # shape, so each stage gets its own communicator + compiled step; the
+    # {x̂, s} carry has ratio-independent shapes and flows across stages
+    # unchanged.  After warmup the pre-built default-ratio programs run.
+    def _effective_ratio(epoch: int) -> float:
+        w = config.compress_warmup_epochs
+        if not w or epoch >= w:
+            return config.compress_ratio
+        return config.compress_ratio * (epoch / w)
+
+    _stages: Dict[float, tuple] = {}
+
+    def _stage_fns(epoch: int):
+        """(communicator, step_fn, scan_step, comm_timer) for this epoch."""
+        ratio = _effective_ratio(epoch)
+        if ratio == config.compress_ratio:
+            return None  # default programs (built below, shared state)
+        if ratio not in _stages:
+            comm = select_communicator(
+                config.communicator, schedule, mesh=mesh, ratio=ratio,
+                consensus_lr=config.consensus_lr,
+                backend=config.gossip_backend, compressor=config.compressor,
+                seed=config.seed, block_d=config.gossip_block_d,
+                w_window=config.gossip_w_window,
+            )
+            sf = _make_step(comm)
+            _stages[ratio] = (
+                comm, sf,
+                _make_epoch_scan(sf) if config.scan_epoch else None,
+                _make_comm_timer(comm, flattener)
+                if config.measure_comm_split and config.communicator != "none"
+                else None,
+            )
+        return _stages[ratio]
 
     start_epoch = 0
     if resume_dir is None:
@@ -179,8 +221,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     rng = jax.random.PRNGKey(config.seed)
     history: List[Dict] = []
 
-    if config.scan_epoch:
-        scan_step = _make_epoch_scan(step_fn)
+    scan_step = _make_epoch_scan(step_fn) if config.scan_epoch else None
 
     # comp/comm split (SURVEY.md §5.1): XLA fuses gossip into the train step,
     # so the reference's timer-around-sendrecv (train_mpi.py:138-143) cannot
@@ -193,15 +234,19 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         comm_timer = _make_comm_timer(communicator, flattener)
 
     for epoch in range(start_epoch, config.epochs):
+        e_step, e_scan, e_timer = step_fn, scan_step, comm_timer
+        stage = _stage_fns(epoch)
+        if stage is not None:  # compression-warmup epoch: ramped-ratio programs
+            _, e_step, e_scan, e_timer = stage
         t0 = time.time()
         if config.scan_epoch:
             state, epoch_metrics = _run_epoch_scanned(
-                scan_step, state, loader, epoch, rng, config.scan_chunk)
+                e_scan, state, loader, epoch, rng, config.scan_chunk)
         else:
             sums: Dict[str, float] = {}
             count = 0
             for xb, yb in loader.epoch(epoch):
-                state, m = step_fn(state, jnp.asarray(xb), jnp.asarray(yb), rng)
+                state, m = e_step(state, jnp.asarray(xb), jnp.asarray(yb), rng)
                 for k, v in m.items():
                     sums[k] = sums.get(k, 0.0) + float(v)
                 count += 1
@@ -232,9 +277,9 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 )
 
         comm_time = comm_encode_time = 0.0
-        if comm_timer is not None:
+        if e_timer is not None:
             window = schedule.flags[epoch * bpe : (epoch + 1) * bpe]
-            split = comm_timer(state, window)
+            split = e_timer(state, window)
             comm_time = min(split["comm_time"], epoch_time)
             # encode is a component of comm_time, never exceeding it
             comm_encode_time = min(split["comm_encode_time"], comm_time)

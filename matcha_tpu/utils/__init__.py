@@ -1,8 +1,8 @@
 """Shared utilities: metrics, timing, profiling, backend pinning."""
 
 from .metrics import AverageMeter, cross_entropy_loss, top_k_accuracy
-from .platform import pin_platform
+from .platform import pin_platform, user_cache_dir
 from .profiling import annotate, trace
 
-__all__ = ["AverageMeter", "annotate", "cross_entropy_loss", "pin_platform",
+__all__ = ["AverageMeter", "annotate", "cross_entropy_loss", "pin_platform", "user_cache_dir",
            "top_k_accuracy", "trace"]

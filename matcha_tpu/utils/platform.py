@@ -14,18 +14,37 @@ import os
 import tempfile
 from typing import Optional
 
-__all__ = ["pin_platform"]
+__all__ = ["pin_platform", "user_cache_dir"]
+
+
+def user_cache_dir(sub: str) -> str:
+    """Create + return a private per-user cache dir (mode 0700).
+
+    Lives under ``$XDG_CACHE_HOME``/``~/.cache`` — a path other local users
+    cannot pre-create or poison, unlike any fixed name in world-writable
+    /tmp (ADVICE r4; even uid-suffixed /tmp names are pre-creatable).  Falls
+    back to a uid-suffixed tempdir only when no home is resolvable.
+    """
+    base = os.environ.get("XDG_CACHE_HOME")
+    if not base:
+        home = os.path.expanduser("~")
+        if home and home != "~":
+            base = os.path.join(home, ".cache")
+        else:  # no resolvable home: best effort under tempdir
+            uid = os.getuid() if hasattr(os, "getuid") else "na"
+            base = os.path.join(tempfile.gettempdir(), f"matcha_cache_u{uid}")
+    path = os.path.join(base, "matcha_tpu", sub)
+    os.makedirs(path, mode=0o700, exist_ok=True)
+    return path
 
 
 def _cache_dir() -> str:
-    """Per-user compile-cache path: a fixed shared /tmp name is writable (or
-    pre-populatable) by any user on a multi-user host (ADVICE r4).  An
-    explicit ``JAX_COMPILATION_CACHE_DIR`` wins outright."""
+    """Compile-cache path: an explicit ``JAX_COMPILATION_CACHE_DIR`` wins
+    outright; otherwise the private per-user cache dir."""
     explicit = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if explicit:
         return explicit
-    uid = os.getuid() if hasattr(os, "getuid") else "na"
-    return os.path.join(tempfile.gettempdir(), f"jax_cache_u{uid}")
+    return user_cache_dir("jax")
 
 
 def pin_platform(name: Optional[str]) -> None:

@@ -102,6 +102,31 @@ def test_train_all_communicators(communicator):
         assert hist[-1]["disagreement"] < 1e-4
 
 
+def test_train_choco_compression_warmup():
+    """Warmup ramps the drop-ratio 0→0.9 across its stage programs; the
+    {x̂, s} carry crosses stage boundaries unchanged, and the dense-rate
+    early consensus must leave replicas at least as tight after epoch 0 as
+    the cold top-k-10% start does."""
+    base = dataclasses.replace(BASE, communicator="choco", compress_ratio=0.9,
+                               consensus_lr=0.2, epochs=4)
+    cold = train(base).history
+    warm = train(dataclasses.replace(base, compress_warmup_epochs=3)).history
+    assert warm[-1]["loss"] < warm[0]["loss"]
+    # epoch 0 runs at ratio 0.0 (keep-all): consensus cannot be looser than
+    # the compressed cold start's (generous 1.5x slack: different top-k
+    # trajectories make the exact values incomparable)
+    assert warm[0]["disagreement"] <= cold[0]["disagreement"] * 1.5
+    # the final epoch runs at the full ratio in both runs
+    assert warm[-1]["active_matchings"] == cold[-1]["active_matchings"]
+
+
+def test_compress_warmup_validation():
+    with pytest.raises(ValueError, match="compress_warmup_epochs"):
+        TrainConfig(compress_warmup_epochs=2)  # decen: not compressed
+    with pytest.raises(ValueError, match="compress_warmup_epochs"):
+        TrainConfig(communicator="choco", compress_warmup_epochs=-1)
+
+
 def test_train_conv_model_smoke():
     """A conv model through the vmapped train step (not just a forward pass —
     test_models stops there): ResNet-8, 4 workers on a generator ring, two

@@ -69,6 +69,12 @@ def parse_args(argv=None) -> TrainConfig:
                    help="CHOCO message compressor (the reference's reserved "
                         "extension point, communicator.py:186-187)")
     p.add_argument("--consensus-lr", type=float, default=0.1, dest="consensus_lr")
+    p.add_argument("--compress-warmup-epochs", type=int, default=0,
+                   dest="compress_warmup_epochs",
+                   help="ramp the CHOCO drop-ratio 0→--ratio over this many "
+                        "epochs (dense-rate consensus while replicas are far "
+                        "apart); each distinct ratio compiles its own step, "
+                        "so keep small. 0 disables (reference behavior)")
     p.add_argument("--centralized", action="store_true", help="AllReduce baseline")
     p.add_argument("--randomSeed", type=int, default=9001, dest="seed")
     p.add_argument("--backend", default="auto",
@@ -131,6 +137,7 @@ def parse_args(argv=None) -> TrainConfig:
         seed=args.seed, communicator=communicator,
         compress_ratio=args.ratio, compressor=args.compressor,
         consensus_lr=args.consensus_lr,
+        compress_warmup_epochs=args.compress_warmup_epochs,
         gossip_backend=args.backend, gossip_block_d=args.block_d,
         gossip_w_window=args.w_window, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
