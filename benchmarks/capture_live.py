@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Run `python bench.py` and, when the final record is a real on-TPU
+measurement, persist it verbatim as benchmarks/bench_live_r{N}.json — the
+committed hardware-evidence artifact the bench fallback path cites
+(bench.py orchestrate: last_live_artifact).  Round 4 captured this by hand;
+automating it means any live window the session catches leaves the artifact
+even if the tunnel dies minutes later.
+
+Usage: python benchmarks/capture_live.py --round 5 [-- extra bench args]
+Exit code: bench.py's (the capture itself never fails the session).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int, required=True)
+    args, bench_args = p.parse_known_args()
+    args.bench_args = bench_args  # everything else passes through to bench.py
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+
+    # stream bench.py's stdout line-by-line (tee semantics): the provisional
+    # record must reach the session log the moment bench prints it — a
+    # buffered pipe would lose everything if the session is killed while the
+    # tunnel wedges mid-attempt (the exact scenario the bench survives)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "bench.py")] + args.bench_args,
+        stdout=subprocess.PIPE, text=True, cwd=repo)
+    record = None
+    for line in proc.stdout:
+        sys.stdout.write(line)
+        sys.stdout.flush()
+        if line.startswith("{"):
+            try:
+                record = json.loads(line)  # last parseable line wins
+            except json.JSONDecodeError:
+                pass
+    proc.wait()
+    kind = (record or {}).get("device_kind", "")
+    if record and "tpu" in kind.lower().replace(" ", ""):
+        out = os.path.join(here, f"bench_live_r{args.round}.json")
+        stamp = time.strftime("%Y-%m-%d %H:%MZ", time.gmtime())
+        with open(out, "w") as f:
+            json.dump({
+                "note": f"Live-tunnel window measurement, r{args.round} "
+                        f"builder session {stamp}. Output of `python "
+                        "bench.py` captured verbatim by "
+                        "benchmarks/capture_live.py; the same command the "
+                        "driver runs.",
+                "record": record,
+            }, f, indent=1)
+        print(f"# live artifact written: {out}", file=sys.stderr)
+    else:
+        print(f"# no TPU record to capture (device_kind={kind!r})",
+              file=sys.stderr)
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

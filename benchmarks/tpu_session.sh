@@ -19,8 +19,10 @@ set -x
 timeout -k 30 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_RC=$?
 
 # 1. THE driver artifact: per-step primary + chunked secondary (≤ ~9 min);
-#    runs even on a broken tunnel (bounded attempts + CPU provisional)
-python bench.py
+#    runs even on a broken tunnel (bounded attempts + CPU provisional).
+#    capture_live persists an on-TPU record as bench_live_r5.json — the
+#    committed hardware evidence the fallback path cites.
+python benchmarks/capture_live.py --round 5
 [ "$GATE_RC" -eq 0 ] || { echo "gate failed (rc=$GATE_RC): skipping tuning steps"; exit 1; }
 
 # Every step below is timeout-wrapped: the tunnel's observed failure mode
@@ -32,7 +34,17 @@ python bench.py
 #    HBM).  Generous bound: the program compiles are the cost; they persist
 #    in the compile cache, so even a timed-out attempt pays forward.
 timeout -k 30 1500 python benchmarks/train_step_bench.py --remat --grad-chunk 32 \
-    --out benchmarks/train_step_bench.json
+    --out benchmarks/train_step_r5.json
+
+# 2.2 >HBM scale probe (docs/DESIGN.md scale section, VERDICT r5 item 6):
+#     the largest BASELINE-config-5-shaped setup that fits ONE v5e —
+#     64 virtual workers x ResNet-50@224 (f32 state+momentum ~13 GB) with
+#     remat + 8-worker fwd/bwd slabs; 256 workers needs the C>=4-chip
+#     folded plan (see the DESIGN.md arithmetic), which this chip count
+#     cannot host — the dryrun_multichip path covers its program instead.
+timeout -k 30 1500 python benchmarks/train_step_bench.py --model resnet50 \
+    --image-size 224 --classes 1000 --workers 64 --batch 2 --steps 2 \
+    --remat --grad-chunk 8 --out benchmarks/scale_probe_r5.json
 
 # 2.5 kernel-scheduling probe (after the headline: a probe stall must not cost step 2): can the per-step cast overlap the MXU via
 #     column splitting? (candidate for closing the last ~9% to the per-step
@@ -53,7 +65,11 @@ timeout -k 30 420 python benchmarks/encode_bench.py --out benchmarks/encode_benc
 #    each config gets an hour (the run_baselines SIGTERM handler records an
 #    explicit error line if the budget still isn't enough) and -k guarantees
 #    a KILL if the tunnel stall leaves the client unkillable-by-TERM.
-for c in choco-resnet-cifar10-64w matcha-vgg16-cifar10-8w \
+#    r5 ordering: the compression-warmup fix for the config-4 plateau and
+#    the real-RGB-pixel photo configs lead (VERDICT r5 items 1 and 4).
+for c in choco-resnet-cifar10-64w-warmup matcha-resnet-photo-8w \
+         choco-resnet-cifar10-64w dpsgd-resnet-photo-8w \
+         central-resnet-photo-8w matcha-vgg16-cifar10-8w \
          matcha-wrn-cifar100-16w dpsgd-resnet-cifar10-8w \
          matcha-resnet50-imagenet-256w matcha-mlp-digits-8w; do
     timeout -k 30 3600 python benchmarks/run_baselines.py --scale converge \

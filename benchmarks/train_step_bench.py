@@ -43,13 +43,17 @@ def measure(args) -> dict:
 
     from matcha_tpu import topology as tp
     from matcha_tpu.communicator import select_communicator
-    from matcha_tpu.models import ResNet
+    from matcha_tpu.models import select_model
     from matcha_tpu.schedule import matcha_schedule
     from matcha_tpu.train import make_lr_schedule
     from matcha_tpu.train.state import init_train_state, make_optimizer, make_train_step
 
     n, b = args.workers, args.batch
-    model = ResNet(depth=20, num_classes=10, remat=args.remat)
+    hw = args.image_size
+    # dataset name only routes the zoo's variant choice: any 224 image size
+    # picks the ImageNet 4-stage variant for 'res*' names
+    model = select_model(args.model, "imagenet" if hw >= 64 else "cifar10",
+                         num_classes=args.classes, remat=args.remat)
     print(f"# [{time.strftime('%H:%M:%S')}] building {n}-worker schedule "
           f"(CVX solve ~60-90s at 256)...", file=sys.stderr, flush=True)
     edges = tp.make_graph("geometric", n, seed=1)
@@ -62,9 +66,15 @@ def measure(args) -> dict:
     optimizer = make_optimizer(lr)
 
     rng = np.random.default_rng(0)
-    xb = jnp.asarray(rng.normal(size=(n, b, 32, 32, 3)).astype(np.float32))
-    yb = jnp.asarray(rng.integers(0, 10, size=(n, b)).astype(np.int32))
+    xb = jnp.asarray(rng.normal(size=(n, b, hw, hw, 3)).astype(np.float32))
+    yb = jnp.asarray(rng.integers(0, args.classes, size=(n, b)).astype(np.int32))
     key = jax.random.PRNGKey(0)
+    # flat parameter count, from shapes only (no init program on the tunnel)
+    var_shapes = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, hw, hw, 3)), train=False),
+        jax.random.PRNGKey(0))
+    d = sum(int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(var_shapes["params"]))
 
     def log(msg):
         # stage-by-stage wall-clock breadcrumbs on stderr: a timed-out
@@ -77,7 +87,7 @@ def measure(args) -> dict:
         comm = select_communicator(comm_name, sched)
         log(f"{comm_name}: init_train_state...")
         state, flattener = init_train_state(
-            model, (32, 32, 3), n, optimizer, comm, seed=0)
+            model, (hw, hw, 3), n, optimizer, comm, seed=0)
         jax.block_until_ready(state.params)
         log(f"{comm_name}: init done; compiling {args.steps}-step chain...")
         step = make_train_step(model, optimizer, comm, flattener, sched.flags,
@@ -110,11 +120,18 @@ def measure(args) -> dict:
     rate_full = steps_per_sec("decen")
     rate_none = steps_per_sec("none")
 
-    d = 273258  # ResNet-20 flat parameter count (bench.py measures it live)
-    flops_fwd_bwd = 3 * 2 * n * b * 41.0e6  # fwd + ~2x bwd, F≈41 MFLOP/img
+    # per-image forward FLOPs at the canonical sizes; off-canonical image
+    # sizes scale ~quadratically with the spatial area.  Models without a
+    # table entry get NO fwd/bwd roofline numbers (omitting beats emitting
+    # a confidently-wrong gossip_flop_share of 1.0).
+    canon = {"resnet20": (32, 41.0e6), "resnet50": (224, 4.1e9)}
+    base = canon.get(args.model.lower())
+    f_img = base[1] * (hw / base[0]) ** 2 if base else None
+    flops_fwd_bwd = 3 * 2 * n * b * f_img if f_img else None  # fwd + ~2x bwd
     flops_gossip = 2.0 * n * n * d
     record = {
-        "metric": f"train-steps/sec @ {n} workers x batch {b}, ResNet-20, "
+        "metric": f"train-steps/sec @ {n} workers x batch {b}, "
+                  f"{args.model}@{hw}px, "
                   f"MATCHA budget 0.5 (gossip fused into the step)",
         "value": round(rate_full, 3),
         "unit": "train_steps_per_sec",
@@ -122,16 +139,21 @@ def measure(args) -> dict:
         "gossip_marginal_frac": round(
             max(0.0, 1.0 - rate_full / max(rate_none, 1e-9)), 4),
         "roofline": {
-            "flops_fwd_bwd_per_step": flops_fwd_bwd,
+            **({"flops_fwd_bwd_per_step": flops_fwd_bwd,
+                "gossip_flop_share": round(
+                    flops_gossip / (flops_gossip + flops_fwd_bwd), 4)}
+               if flops_fwd_bwd else
+               {"note_fwd_bwd": f"no canonical FLOP table entry for "
+                                f"{args.model}; fwd/bwd share omitted"}),
             "flops_gossip_per_step": flops_gossip,
-            "gossip_flop_share": round(
-                flops_gossip / (flops_gossip + flops_fwd_bwd), 4),
             "note": "gossip-steps/sec in a training run == train-steps/sec; "
                     "the isolated gossip kernel rate (bench.py value) bounds "
                     "the comm term, and the FLOP share bounds what any "
                     "budget<1 can save on-chip",
         },
-        "workers": n, "batch": b, "steps": args.steps, "reps": args.reps,
+        "workers": n, "batch": b, "model": args.model,
+        "image_size": hw, "flat_dim": d,
+        "steps": args.steps, "reps": args.reps,
         "remat": args.remat, "grad_chunk": args.grad_chunk or None,
         "device_kind": jax.devices()[0].device_kind,
     }
@@ -142,6 +164,12 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--model", default="resnet20",
+                   help="zoo name (resnet20|resnet50|vgg16|wrn|mlp); "
+                        "resnet50 + --image-size 224 is the BASELINE "
+                        "config-5 scale probe")
+    p.add_argument("--image-size", type=int, default=32, dest="image_size")
+    p.add_argument("--classes", type=int, default=10)
     p.add_argument("--steps", type=int, default=4,
                    help="train steps per timed chain (min 1)")
     p.add_argument("--reps", type=int, default=2)

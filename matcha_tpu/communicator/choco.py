@@ -49,7 +49,7 @@ __all__ = ["make_choco"]
 
 
 def _choco_core(vals, idx, x_hat, s, flat, flags_t, *, gather_msg, partnered_rows,
-                matching_nonempty, alpha, consensus_lr):
+                matching_nonempty, alpha, consensus_lr, aligned_full=False):
     """Shared per-step CHOCO math given this block's top-k messages.
 
     ``gather_msg(j) -> (vals[π_j], idx[π_j])`` abstracts the neighbor
@@ -57,7 +57,25 @@ def _choco_core(vals, idx, x_hat, s, flat, flags_t, *, gather_msg, partnered_row
     ``partnered_rows``: ``f32[M, R]`` partner mask for the R rows held here
     (may be traced); ``matching_nonempty``: static per-matching bools letting
     globally-empty matchings drop out of the compiled program.
+
+    Keep-all fast path (``aligned_full``, set only for the exact ``top_k``
+    compressor whose keep-all branch emits arange indices): when the
+    message width equals the state width (a ratio-0 compression-warmup
+    stage), every index row is arange and any gather of those rows is
+    arange too — the scatters degenerate to dense weighted adds, which XLA
+    fuses instead of lowering O(N·D) scatters.  Other compressors (e.g.
+    random_k at k=D emits a *permutation*) keep the general scatter.
     """
+    keep_all = aligned_full and vals.shape[-1] == s.shape[-1]
+
+    def add(base, g_idx, g_vals, scale):
+        if not keep_all:
+            return scatter_rows(base, g_idx, g_vals, scale)
+        sc = jnp.asarray(scale, base.dtype)
+        if sc.ndim == 1:
+            sc = sc[:, None]
+        return base + sc * g_vals
+
     active = (jnp.sum(flags_t) > 0).astype(flat.dtype)  # 0 ⇒ frozen step
     partnered_rows = jnp.asarray(partnered_rows)
     for j in range(len(matching_nonempty)):
@@ -65,12 +83,12 @@ def _choco_core(vals, idx, x_hat, s, flat, flags_t, *, gather_msg, partnered_row
             continue  # no edges anywhere: zero contribution, skip statically
         g_vals, g_idx = gather_msg(j)
         scale = active * flags_t[j] * alpha * partnered_rows[j]
-        s = scatter_rows(s, g_idx, g_vals, scale)
+        s = add(s, g_idx, g_vals, scale)
 
     # self message with per-row weight 1 − d_i·α (d = active degree)
     deg = partnered_rows.T @ flags_t  # [R]
-    s = scatter_rows(s, idx, vals, active * (1.0 - deg * alpha))
-    x_hat = scatter_rows(x_hat, idx, vals, active)
+    s = add(s, idx, vals, active * (1.0 - deg * alpha))
+    x_hat = add(x_hat, idx, vals, active)
     flat = flat + active * consensus_lr * (s - x_hat)
     return flat, x_hat, s
 
@@ -150,6 +168,7 @@ def make_choco(
                 gather_msg=gather_msg, partnered_rows=partnered,
                 matching_nonempty=nonempty,
                 alpha=alpha, consensus_lr=consensus_lr,
+                aligned_full=(compressor == "top_k"),
             )
             out = {"x_hat": x_hat, "s": s}
             if stochastic:
@@ -202,6 +221,7 @@ def make_choco(
             gather_msg=gather_msg, partnered_rows=partnered_rows,
             matching_nonempty=nonempty,
             alpha=alpha, consensus_lr=consensus_lr,
+            aligned_full=(compressor == "top_k"),
         )
 
     def body_one(flat_blk, x_hat_blk, s_blk, flags_t, key):

@@ -9,6 +9,7 @@ from .datasets import (
     normalize,
     normalized_zero,
     synthetic_classification,
+    photo_patches,
     synthetic_images,
     uci_digits,
 )
@@ -32,6 +33,7 @@ __all__ = [
     "partition_label_skew",
     "partition_uniform",
     "synthetic_classification",
+    "photo_patches",
     "synthetic_images",
     "uci_digits",
 ]

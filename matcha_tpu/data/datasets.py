@@ -24,6 +24,7 @@ __all__ = [
     "synthetic_classification",
     "synthetic_images",
     "uci_digits",
+    "photo_patches",
     "load_npz",
     "normalize",
     "augment_crop_flip",
@@ -41,6 +42,10 @@ NORMALIZATION = {
     # the full 1,797-image set after the /16 range scale — fixed like the
     # torchvision-style constants above, not recomputed per split
     "digits": ((0.3053,), (0.376,)),
+    # photo_patches (the real-RGB-pixel dataset built from photographs baked
+    # into the image's site-packages — see photo_patches()); constants over
+    # the default build's train split, fixed like the rest
+    "photo_patches": ((0.3268, 0.3297, 0.4519), (0.2842, 0.2408, 0.2898)),
 }
 
 
@@ -129,6 +134,95 @@ def uci_digits(num_test: int = 360, seed: int = 0) -> Dataset:
     order = np.random.default_rng(seed).permutation(len(y))
     test, train = order[:num_test], order[num_test:]
     return Dataset(x[train], y[train], x[test], y[test], 10, name="digits")
+
+
+# Real photographs shipped inside the image's baked site-packages (module →
+# relative path).  Each becomes one class of photo_patches; paths resolve via
+# find_spec so nothing here imports (pygame's __init__ prints a banner).
+_PHOTO_SOURCES = (
+    ("china", "sklearn", "datasets/images/china.jpg"),
+    ("flower", "sklearn", "datasets/images/flower.jpg"),
+    ("hopper", "matplotlib", "mpl-data/sample_data/grace_hopper.jpg"),
+    ("fist", "pygame", "examples/data/fist.png"),
+    ("canyon", "pygame", "examples/data/arraydemo.bmp"),
+    ("freedom", "pygame", "docs/generated/_images/intro_freedom.jpg"),
+    ("blade", "pygame", "docs/generated/_images/intro_blade.jpg"),
+    ("room", "pygame", "docs/generated/_images/camera_background.jpg"),
+)
+
+
+def photo_patches(
+    train_per_class: int = 768,
+    test_per_class: int = 128,
+    patch: int = 32,
+    seed: int = 0,
+) -> Dataset:
+    """Real-photograph patch classification, fully offline.
+
+    The environment has no network egress and no real CIFAR archive (the
+    repo's CIFAR *fixtures* are format-faithful random noise — see
+    tests/fixtures/make_fixtures.py), so this is the in-environment analog
+    of the reference's CIFAR conv-net configs (util.py:117-149): one class
+    per distinct real photograph baked into site-packages, ``patch²`` RGB
+    crops sampled from it.  Train and test crops come from spatially
+    DISJOINT image regions (train: left 70% of the width; test: right 30%,
+    with a full patch-width gap) so test accuracy measures generalization to
+    unseen pixels of the scene, not crop memorization.  Raw [0,1] pixels are
+    standardized with the fixed ``photo_patches`` constants.
+
+    Sources that are missing on a stripped install are skipped;
+    ``num_classes`` is however many resolve (≥4 required).  Deterministic
+    for a given seed.
+    """
+    import importlib.util
+
+    rng = np.random.default_rng(seed)
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    label = 0
+    names = []
+    for name, module, rel in _PHOTO_SOURCES:
+        spec = importlib.util.find_spec(module)
+        if spec is None or not spec.submodule_search_locations:
+            continue
+        path = f"{spec.submodule_search_locations[0]}/{rel}"
+        try:
+            from PIL import Image
+
+            img = np.asarray(Image.open(path).convert("RGB"), np.float32) / 255.0
+        except Exception:  # noqa: BLE001 — stripped install: skip the class
+            continue
+        h, w = img.shape[:2]
+        split = int(0.7 * w)
+        # train x-origin ∈ [0, split−patch] ⇒ train pixels end at column
+        # split−1; test x-origin ∈ [split, w−patch] ⇒ test pixels start at
+        # column split.  Disjoint by construction, no shared pixel.
+        if h < patch or split - patch < 1 or w - patch < split:
+            continue
+
+        def crops(n, x_lo, x_hi):
+            ox = rng.integers(x_lo, x_hi + 1, size=n)
+            oy = rng.integers(0, h - patch + 1, size=n)
+            return np.stack([img[y : y + patch, x : x + patch]
+                             for y, x in zip(oy, ox)])
+
+        xs_tr.append(crops(train_per_class, 0, split - patch))
+        xs_te.append(crops(test_per_class, split, w - patch))
+        ys_tr.append(np.full(train_per_class, label, np.int32))
+        ys_te.append(np.full(test_per_class, label, np.int32))
+        names.append(name)
+        label += 1
+    if label < 4:
+        raise RuntimeError(
+            f"photo_patches found only {label} source photographs "
+            f"({names}); need >= 4 for a meaningful task"
+        )
+    mean, std = NORMALIZATION["photo_patches"]
+    norm = lambda x: (x - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+    return Dataset(
+        norm(np.concatenate(xs_tr)), np.concatenate(ys_tr),
+        norm(np.concatenate(xs_te)), np.concatenate(ys_te),
+        label, name="photo_patches",
+    )
 
 
 def load_npz(path: str, dataset: str = "cifar10", num_classes: int | None = None) -> Dataset:

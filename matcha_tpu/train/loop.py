@@ -32,6 +32,7 @@ from ..data import (
     load_npz,
     normalized_zero,
     partition_indices,
+    photo_patches,
     synthetic_classification,
     synthetic_images,
     uci_digits,
@@ -95,6 +96,8 @@ def build_dataset(config: TrainConfig):
         return synthetic_images(seed=config.seed, **kwargs)
     if config.dataset == "digits":
         return uci_digits(seed=config.seed, **kwargs)
+    if config.dataset == "photo_patches":
+        return photo_patches(seed=config.seed, **kwargs)
     if config.datasetRoot is None:
         raise ValueError(
             f"dataset '{config.dataset}' needs datasetRoot pointing at an .npz "
@@ -135,13 +138,16 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if mesh is not None and (mesh.size == 1 or config.num_workers % mesh.size):
         mesh = None  # single chip or non-divisible fold: dense backend (auto)
 
-    communicator = select_communicator(
-        config.communicator, schedule, mesh=mesh,
-        ratio=config.compress_ratio, consensus_lr=config.consensus_lr,
-        backend=config.gossip_backend, compressor=config.compressor,
-        seed=config.seed, block_d=config.gossip_block_d,
-        w_window=config.gossip_w_window,
-    )
+    def _make_comm(ratio: float):
+        return select_communicator(
+            config.communicator, schedule, mesh=mesh,
+            ratio=ratio, consensus_lr=config.consensus_lr,
+            backend=config.gossip_backend, compressor=config.compressor,
+            seed=config.seed, block_d=config.gossip_block_d,
+            w_window=config.gossip_w_window,
+        )
+
+    communicator = _make_comm(config.compress_ratio)
 
     model = select_model(config.model, config.dataset,
                          num_classes=dataset.num_classes, remat=config.remat)
@@ -191,13 +197,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         if ratio == config.compress_ratio:
             return None  # default programs (built below, shared state)
         if ratio not in _stages:
-            comm = select_communicator(
-                config.communicator, schedule, mesh=mesh, ratio=ratio,
-                consensus_lr=config.consensus_lr,
-                backend=config.gossip_backend, compressor=config.compressor,
-                seed=config.seed, block_d=config.gossip_block_d,
-                w_window=config.gossip_w_window,
-            )
+            comm = _make_comm(ratio)
             sf = _make_step(comm)
             _stages[ratio] = (
                 comm, sf,

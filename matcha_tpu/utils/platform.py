@@ -26,6 +26,7 @@ def user_cache_dir(sub: str) -> str:
     back to a uid-suffixed tempdir only when no home is resolvable.
     """
     base = os.environ.get("XDG_CACHE_HOME")
+    tmp_fallback = False
     if not base:
         home = os.path.expanduser("~")
         if home and home != "~":
@@ -33,8 +34,20 @@ def user_cache_dir(sub: str) -> str:
         else:  # no resolvable home: best effort under tempdir
             uid = os.getuid() if hasattr(os, "getuid") else "na"
             base = os.path.join(tempfile.gettempdir(), f"matcha_cache_u{uid}")
+            tmp_fallback = True
     path = os.path.join(base, "matcha_tpu", sub)
     os.makedirs(path, mode=0o700, exist_ok=True)
+    if tmp_fallback and hasattr(os, "getuid"):
+        # a pre-existing dir under world-writable tempdir may be another
+        # user's plant (exist_ok accepts it silently, and makedirs never
+        # re-modes an existing leaf): insist on ownership + 0700
+        st = os.stat(base)
+        if st.st_uid != os.getuid():
+            raise RuntimeError(
+                f"cache dir {base} is owned by uid {st.st_uid}, not "
+                f"{os.getuid()} — refusing a possibly planted cache; set "
+                "XDG_CACHE_HOME to a private location")
+        os.chmod(base, 0o700)
     return path
 
 

@@ -55,7 +55,10 @@ def parse_args(argv=None) -> TrainConfig:
                    help="generator when --graphid -1 (ring|torus|erdos_renyi|geometric|...)")
     p.add_argument("--numworkers", type=int, default=8)
     p.add_argument("--dataset", default="synthetic",
-                   help="synthetic|synthetic_image|cifar10|cifar100|emnist|imagenet")
+                   help="synthetic|synthetic_image|digits|photo_patches|"
+                        "cifar10|cifar100|emnist|imagenet (the last four "
+                        "need --datasetRoot; digits/photo_patches are real "
+                        "pixels bundled in-image)")
     p.add_argument("--datasetRoot", default=None, help=".npz path for real datasets")
     p.add_argument("--noniid", action="store_true", help="label-skew partition")
     p.add_argument("--augment", action="store_true")
