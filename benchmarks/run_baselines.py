@@ -129,6 +129,16 @@ CONFIGS = {
         communicator="choco", compress_ratio=0.9,
         compress_warmup_epochs=4, lr=0.8, batch_size=32,
     ),
+    # Diagnostic: the 512-images/worker point of the CHOCO shard-size sweep
+    # (64→256→512; VERDICT r4 item 1's alternate done-criterion).  Plain
+    # reference semantics (no warmup), γ=0.1.  TPU-window only — ~8 h of
+    # pure CPU otherwise.
+    "choco-resnet-cifar10-64w-512shard": TrainConfig(
+        name="choco-resnet-cifar10-64w-512shard", model="resnet20",
+        dataset="cifar10", num_workers=64, graphid=None,
+        topology="geometric", matcha=True, budget=0.5,
+        communicator="choco", compress_ratio=0.9, lr=0.8, batch_size=32,
+    ),
 }
 
 SMOKE_OVERRIDES = {
@@ -160,6 +170,8 @@ SMOKE_OVERRIDES = {
     "choco-resnet-cifar10-64w-warmup": dict(
         dataset="synthetic_image", epochs=1, batch_size=8,
         compress_warmup_epochs=1),
+    "choco-resnet-cifar10-64w-512shard": dict(
+        dataset="synthetic_image", epochs=1, batch_size=8),
 }
 
 # Converging tier: separable synthetic clusters (the budget_sweep/_miniature
@@ -230,6 +242,12 @@ CONVERGE_OVERRIDES = {
         _CONVERGE_DATA, epochs=12, consensus_lr=0.1,
         compress_warmup_epochs=4,
         dataset_kwargs={"num_train": 16384, "num_test": 256,
+                        "separation": 40.0}),
+    # 512 images/worker, same step budget per image (epochs scale down is
+    # NOT applied: more steps is the point of bigger shards)
+    "choco-resnet-cifar10-64w-512shard": dict(
+        _CONVERGE_DATA, epochs=12, consensus_lr=0.1,
+        dataset_kwargs={"num_train": 32768, "num_test": 256,
                         "separation": 40.0}),
 }
 

@@ -69,7 +69,8 @@ timeout -k 30 420 python benchmarks/encode_bench.py --out benchmarks/encode_benc
 #    the real-RGB-pixel photo configs lead (VERDICT r5 items 1 and 4).
 for c in choco-resnet-cifar10-64w-warmup matcha-resnet-photo-8w \
          choco-resnet-cifar10-64w dpsgd-resnet-photo-8w \
-         central-resnet-photo-8w matcha-vgg16-cifar10-8w \
+         central-resnet-photo-8w choco-resnet-cifar10-64w-512shard \
+         matcha-vgg16-cifar10-8w \
          matcha-wrn-cifar100-16w dpsgd-resnet-cifar10-8w \
          matcha-resnet50-imagenet-256w matcha-mlp-digits-8w; do
     timeout -k 30 3600 python benchmarks/run_baselines.py --scale converge \
