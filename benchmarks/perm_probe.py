@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Hardware probe: can the permutation form of W_t beat the dense MXU form?
+
+The fused kernel executes each gossip step as a dense ``W_t @ x`` on the MXU
+and streams the precomputed ``[T, N, N]`` W stack from HBM — that stream is
+the dominant HBM term of the per-step roofline (benchmarks/ROOFLINE.md).
+But W_t is structurally ``I − α·Σ_j flag[t,j]·L_j`` over perfect matchings,
+i.e. per row: ``(W_t x)_i = (1 − α·deg_i,t)·x_i + α·Σ_j flag[t,j]·x_{π_j(i)}``
+with the involutions π_j *static*.  The permutation form therefore needs only
+the ``[T, M]`` flag stream from HBM (≈2,000× smaller) and replaces the MXU
+dot with M static row-shuffles + weighted adds on the VPU.
+
+Whether that wins is a pure hardware-scheduling question: the shuffle of a
+VMEM-resident ``[N, block_d]`` block is sublane data movement whose cost
+Mosaic decides, and the VPU flops (≈(M+2)·N·bd) are ~60× fewer than the
+MXU's 2·N²·bd but run on a ~50× slower unit.  So: measure, don't assume.
+
+Both forms run bf16 in/out with f32 accumulate — the production fused
+kernel's dtypes (bench.py default) — so the dense baseline streams exactly
+the bytes it streams in production.  Correctness is checked on device
+against the dense form and GATES the ratio: outputs that diverge beyond
+bf16 rounding drift mark the record inconclusive and withhold the ratio
+(a silently mis-lowered gather must not trigger integration).  Writes one
+JSON record to --out; exits 0 even when inconclusive.  Run on a live
+tunnel (tpu_session.sh, after the headline steps); `--smoke` pins CPU for
+an off-tunnel correctness check in interpret mode.
+
+Models the hot path of /root/reference/communicator.py:92-122 like bench.py;
+integrate as a gossip backend only if this measures a clear win.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N, D, T, BD, W, M = 256, 273258, 2000, 4096, 8, 10
+ALPHA = 0.37  # representative mixing weight; any fixed value works
+
+
+def main() -> int:
+    global N, D, T, BD, W, M
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for a CPU correctness check")
+    args = p.parse_args()
+    if args.reps < 1:
+        p.error("--reps must be >= 1")
+    if args.smoke:
+        N, D, T, BD, W, M = 16, 1024, 32, 512, 4, 4
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from matcha_tpu.utils import pin_platform
+
+    # --smoke is the off-tunnel correctness check: pin CPU before backend
+    # init or the env's default (tunneled TPU) backend hangs when down
+    pin_platform("cpu" if args.smoke else None)
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    rng = np.random.default_rng(0)
+    # M random involutions with fixed points (matching structure) + a
+    # Bernoulli flag stream at the MATCHA-0.5-like activation rate
+    perms = np.empty((M, N), np.int32)
+    for j in range(M):
+        pi = np.arange(N)
+        pairs = rng.permutation(N)[: 2 * (N // 3)].reshape(-1, 2)
+        pi[pairs[:, 0]], pi[pairs[:, 1]] = pairs[:, 1], pairs[:, 0]
+        perms[j] = pi
+    partnered = (perms != np.arange(N)[None, :]).astype(np.float32)  # [M, N]
+    flags = (rng.random((T, M)) < 0.5).astype(np.float32)
+
+    @jax.jit
+    def gen_x():
+        # bf16 state: the production fused kernel's wire dtype (bench.py
+        # default) — the dense baseline must stream the same bytes it
+        # really streams, or the perm/dense ratio is biased
+        return jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.bfloat16)
+
+    x = gen_x()
+    jax.block_until_ready(x)
+    flags_d = jnp.asarray(flags)
+    partnered_d = jnp.asarray(partnered)
+
+    # --- dense reference: per-step W_t @ x via the W stack (MXU form) ------
+    @jax.jit
+    def build_w_stack():
+        eye = jnp.eye(N, dtype=jnp.float32)
+        deg = flags_d @ partnered_d  # [T, N]
+        w = (1.0 - ALPHA * deg)[:, :, None] * eye[None]
+        onehot = jax.nn.one_hot(jnp.asarray(perms), N, dtype=jnp.float32)
+        # rows i with partner p get α at column p (fixed points already have
+        # their α·x_i folded into the diagonal term via deg=0)
+        for j in range(M):
+            w = w + (ALPHA * flags_d[:, j])[:, None, None] * (
+                partnered_d[j][None, :, None] * onehot[j][None])
+        return w  # f32; cast per use
+
+    def dense_kernel(x_ref, w_ref, o_ref):
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _():
+            o_ref[...] = x_ref[...]
+
+        for k in range(W):
+            o_ref[...] = jnp.dot(
+                w_ref[k], o_ref[...],
+                preferred_element_type=jnp.float32).astype(o_ref.dtype)
+        # (bf16 in/out, f32 accumulate — identical to pallas_gossip)
+
+    interp = jax.devices()[0].platform == "cpu"  # CPU: interpret-mode only
+
+    @jax.jit
+    def run_dense(x, stk):
+        return pl.pallas_call(
+            dense_kernel, grid=(pl.cdiv(D, BD), T // W), interpret=interp,
+            in_specs=[pl.BlockSpec((N, BD), lambda i, t: (0, i)),
+                      pl.BlockSpec((W, N, N), lambda i, t: (t, 0, 0))],
+            out_specs=pl.BlockSpec((N, BD), lambda i, t: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((N, D), x.dtype))(x, stk)
+
+    # --- permutation form: flags stream only, row gathers in VMEM ---------
+    # perms/partnered ride as (replicated-block) kernel inputs: Pallas
+    # forbids captured array constants, and as refs the gathers are traced
+    perms_d = jnp.asarray(perms, jnp.int32)  # [M, N]
+
+    def perm_kernel(x_ref, f_ref, pi_ref, pr_ref, o_ref):
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _():
+            o_ref[...] = x_ref[...]
+
+        pr = pr_ref[...]  # [M, N]
+        for k in range(W):
+            fk = f_ref[k]  # [M]
+            cur = o_ref[...].astype(jnp.float32)  # f32 accumulate, bf16 store
+            deg = fk @ pr  # [N]
+            acc = (1.0 - ALPHA * deg)[:, None] * cur
+            for j in range(M):
+                # row gather: partner rows of this matching (π_j involution)
+                g = jnp.take(cur, pi_ref[j], axis=0)
+                acc = acc + (ALPHA * fk[j] * pr[j])[:, None] * g
+            o_ref[...] = acc.astype(o_ref.dtype)
+
+    @jax.jit
+    def run_perm(x, flags):
+        return pl.pallas_call(
+            perm_kernel, grid=(pl.cdiv(D, BD), T // W), interpret=interp,
+            in_specs=[pl.BlockSpec((N, BD), lambda i, t: (0, i)),
+                      pl.BlockSpec((W, M), lambda i, t: (t, 0)),
+                      pl.BlockSpec((M, N), lambda i, t: (0, 0)),
+                      pl.BlockSpec((M, N), lambda i, t: (0, 0))],
+            out_specs=pl.BlockSpec((N, BD), lambda i, t: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((N, D), x.dtype))(
+                x, flags, perms_d, partnered_d)
+
+    def rate(fn, *a):
+        g = jax.jit(lambda *a: jnp.sum(fn(*a)[:, :8].astype(jnp.float32)))
+        float(g(*a))  # compile + warm, forced readback (tunneled-TPU rule)
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            float(g(*a))
+            best = min(best, time.perf_counter() - t0)
+        return T / best
+
+    rec = {"probe": "perm-vs-dense-fused", "n": N, "d": D, "steps": T,
+           "block_d": BD, "w_window": W, "matchings": M,
+           "device_kind": jax.devices()[0].device_kind}
+    try:
+        stk = build_w_stack()  # f32
+        jax.block_until_ready(stk)
+        # Correctness gate in f32 (same lowering path, no per-step rounding
+        # divergence — bf16's 8-bit mantissa drifts percent-scale over the
+        # chain even when both kernels are right, which would blind the
+        # gate).  A mis-lowered gather is dtype-independent and O(1) off.
+        y_dense = run_dense(x.astype(jnp.float32), stk)
+        y_perm = run_perm(x.astype(jnp.float32), flags_d)
+        err = float(jnp.max(jnp.abs(y_perm - y_dense))
+                    / (jnp.max(jnp.abs(y_dense)) + 1e-30))
+        rec["rel_err_vs_dense_f32"] = err
+        rec["valid"] = err < 1e-3
+        # Rates in the production dtypes: bf16 state/stack, f32 accumulate
+        rec["dense_steps_per_sec"] = round(
+            rate(run_dense, x, stk.astype(jnp.bfloat16)), 1)
+        rec["perm_steps_per_sec"] = round(rate(run_perm, x, flags_d), 1)
+        if rec["valid"]:
+            rec["ratio"] = round(rec["perm_steps_per_sec"]
+                                 / rec["dense_steps_per_sec"], 4)
+        else:
+            rec["inconclusive"] = "f32 outputs diverge; ratio withheld"
+    except Exception as e:  # noqa: BLE001 — the artifact records the failure
+        rec["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,12 @@ timeout -k 30 1500 python benchmarks/train_step_bench.py --model resnet50 \
 #     ceiling — integrate into pallas_gossip only if this measures a win)
 timeout -k 30 420 python benchmarks/split_probe.py --out benchmarks/split_probe.json
 
+# 2.55 permutation-form kernel probe: stream only the [T, M] flags instead
+#      of the [T, N, N] W stack and apply W_t as in-VMEM row gathers —
+#      raises the per-step ceiling if Mosaic lowers the gathers well
+#      (integrate as a backend only on a measured win)
+timeout -k 30 420 python benchmarks/perm_probe.py --out benchmarks/perm_probe.json
+
 # 2.6 CHOCO encode cost: exact vs TPU-native approximate top-k (and the
 #     other registry compressors) at the config-4 shape
 timeout -k 30 420 python benchmarks/encode_bench.py --out benchmarks/encode_bench.json
