@@ -177,6 +177,10 @@ def main() -> int:
     rec = {"probe": "perm-vs-dense-fused", "n": N, "d": D, "steps": T,
            "block_d": BD, "w_window": W, "matchings": M,
            "device_kind": jax.devices()[0].device_kind}
+    if args.smoke:
+        # interpret-mode numbers are correctness evidence only — a smoke
+        # record must never impersonate hardware in the session artifact
+        rec["smoke_interpret_mode"] = True
     try:
         stk = build_w_stack()  # f32
         jax.block_until_ready(stk)
@@ -194,11 +198,14 @@ def main() -> int:
         rec["dense_steps_per_sec"] = round(
             rate(run_dense, x, stk.astype(jnp.bfloat16)), 1)
         rec["perm_steps_per_sec"] = round(rate(run_perm, x, flags_d), 1)
-        if rec["valid"]:
+        if not rec["valid"]:
+            rec["inconclusive"] = "f32 outputs diverge; ratio withheld"
+        elif args.smoke:
+            rec["inconclusive"] = ("interpret-mode timing is meaningless; "
+                                   "ratio withheld (correctness gate only)")
+        else:
             rec["ratio"] = round(rec["perm_steps_per_sec"]
                                  / rec["dense_steps_per_sec"], 4)
-        else:
-            rec["inconclusive"] = "f32 outputs diverge; ratio withheld"
     except Exception as e:  # noqa: BLE001 — the artifact records the failure
         rec["error"] = f"{type(e).__name__}: {str(e)[:300]}"
     line = json.dumps(rec)

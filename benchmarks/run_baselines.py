@@ -251,6 +251,21 @@ CONVERGE_OVERRIDES = {
                         "separation": 40.0}),
 }
 
+# Exact mirror of the uncompressed diag control's converge setup (64-image
+# shards, batch 4, 12 epochs — the config where dense gossip reaches 0.9513)
+# but CHOCO + 4-epoch compression warmup: the tightest A/B for what warmup
+# buys against the committed 0.26 plateau rows, and small enough to finish
+# on the 1-core host.  Registered as its own converge entry.
+CONFIGS["choco-resnet-cifar10-64w-warmup-quick"] = dataclasses.replace(
+    CONFIGS["choco-resnet-cifar10-64w-warmup"],
+    name="choco-resnet-cifar10-64w-warmup-quick")
+SMOKE_OVERRIDES["choco-resnet-cifar10-64w-warmup-quick"] = dict(
+    SMOKE_OVERRIDES["choco-resnet-cifar10-64w-warmup"])
+CONVERGE_OVERRIDES["choco-resnet-cifar10-64w-warmup-quick"] = dict(
+    _CONVERGE_DATA, epochs=12, batch_size=4, consensus_lr=0.1,
+    compress_warmup_epochs=4,
+    dataset_kwargs={"num_train": 4096, "num_test": 256, "separation": 40.0})
+
 
 def main():
     p = argparse.ArgumentParser()
