@@ -29,9 +29,30 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _miniature import miniature_config, timing_stats  # noqa: E402
+from matcha_tpu.plan import plan_candidate  # noqa: E402
+from matcha_tpu.topology import graph_size, select_graph  # noqa: E402
 from matcha_tpu.train import train  # noqa: E402
 
 BUDGETS = (0.1, 0.25, 0.5, 1.0)
+GRAPHID = 2  # the zoo geometric graph every miniature run uses
+
+
+def predicted_columns(budget: float, seed: int = 1) -> dict:
+    """The planner's offline prediction for one sweep point — attached to
+    each measured record so the artifact carries predicted-vs-measured
+    side by side (the planner's falsifiability hook; tests/test_plan.py
+    checks the ranking against the committed table)."""
+    cand = plan_candidate(
+        select_graph(GRAPHID), graph_size(GRAPHID), budget, seed=seed,
+        mc_trials=4, mc_steps=60)
+    return {
+        "rho": round(cand["rho"], 6),
+        "mc_empirical_rate": round(cand["mc_empirical_rate"], 6),
+        "steps_to_target": None if cand["steps_to_target"] is None
+        else round(cand["steps_to_target"], 2),
+        "expected_comm_fraction": round(cand["expected_comm_fraction"], 4),
+        "expected_comm_units": cand["expected_comm_units"],
+    }
 
 
 def run_one(label: str, epochs: int, *, matcha: bool, budget: float = 1.0,
@@ -68,6 +89,8 @@ def run_one(label: str, epochs: int, *, matcha: bool, budget: float = 1.0,
     }
     record["comm_fraction"] = round(
         record["mean_comm_time_per_epoch"] / max(record["mean_epoch_time"], 1e-9), 4)
+    if matcha:
+        record["predicted"] = predicted_columns(budget)
     print(json.dumps(record), flush=True)
     return record
 
@@ -87,6 +110,19 @@ def main():
                             reps=args.reps))
 
     dpsgd_acc = runs[0]["final_test_acc"]
+    # predicted-vs-measured ordering: the planner's iteration count to the
+    # consensus target against the measured epochs-to-0.9-accuracy
+    matcha_runs = [r for r in runs if r["algorithm"] == "matcha"]
+    predicted_rank = [r["budget"] for r in sorted(
+        matcha_runs,
+        key=lambda r: (float("inf")
+                       if r["predicted"]["steps_to_target"] is None
+                       else r["predicted"]["steps_to_target"]))]
+    measured_rank = [r["budget"] for r in sorted(
+        matcha_runs,
+        key=lambda r: next(
+            (i for i, a in enumerate(r["test_acc_curve"]) if a >= 0.9),
+            len(r["test_acc_curve"])))]
     summary = {
         "experiment": "MATCHA budget sweep vs D-PSGD "
                       "(ResNet-20, synthetic CIFAR shapes, 16 workers, graphid 2)",
@@ -100,6 +136,11 @@ def main():
             next(r["final_test_acc"] for r in runs
                  if r["algorithm"] == "matcha" and r["budget"] == 0.5) - dpsgd_acc,
             4),
+        # planner cross-check (matcha_tpu.plan): budgets ordered by
+        # predicted steps-to-consensus vs by measured epochs-to-0.9 — the
+        # sweep now carries its own prediction audit trail
+        "predicted_rank_by_budget": predicted_rank,
+        "measured_rank_by_budget": measured_rank,
     }
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=1)

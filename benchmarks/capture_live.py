@@ -24,6 +24,11 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--round", type=int, required=True)
     args, bench_args = p.parse_known_args()
+    if bench_args and bench_args[0] == "--":
+        # parse_known_args leaves the documented `--` separator in the
+        # unknown list (ADVICE r5); forwarding it literally would feed
+        # bench.py a bogus positional
+        bench_args = bench_args[1:]
     args.bench_args = bench_args  # everything else passes through to bench.py
     here = os.path.dirname(os.path.abspath(__file__))
     repo = os.path.dirname(here)

@@ -129,6 +129,22 @@ CONFIGS = {
         communicator="choco", compress_ratio=0.9,
         compress_warmup_epochs=4, lr=0.8, batch_size=32,
     ),
+    # Diagnostic: the control the r5 warmup A/B is missing (ADVICE r5).
+    # Fixed-schedule CHOCO — all matchings every step, γ=0.1 — on the same
+    # 64-worker geometric graph: the regime where CHOCO's telescoping-s
+    # assumption actually holds (W is constant).  Same 4-epoch compression
+    # warmup as the A/B arm, so the compression trajectory is identical and
+    # ONLY the schedule differs.  Separates "γ-damped mixing is too slow at
+    # 64 workers" (this run also stalls) from "the time-varying-W
+    # accumulator cross-terms are the bias" (this run learns while the
+    # MATCHA-scheduled one stalls).
+    "choco-resnet-cifar10-64w-fixed": TrainConfig(
+        name="choco-resnet-cifar10-64w-fixed", model="resnet20",
+        dataset="cifar10", num_workers=64, graphid=None,
+        topology="geometric", matcha=False, fixed_mode="all",
+        communicator="choco", compress_ratio=0.9, consensus_lr=0.1,
+        compress_warmup_epochs=4, lr=0.8, batch_size=32,
+    ),
     # Diagnostic: the 512-images/worker point of the CHOCO shard-size sweep
     # (64→256→512; VERDICT r4 item 1's alternate done-criterion).  Plain
     # reference semantics (no warmup), γ=0.1.  TPU-window only — ~8 h of
@@ -168,6 +184,9 @@ SMOKE_OVERRIDES = {
         epochs=1, batch_size=8,
         dataset_kwargs={"train_per_class": 32, "test_per_class": 8}),
     "choco-resnet-cifar10-64w-warmup": dict(
+        dataset="synthetic_image", epochs=1, batch_size=8,
+        compress_warmup_epochs=1),
+    "choco-resnet-cifar10-64w-fixed": dict(
         dataset="synthetic_image", epochs=1, batch_size=8,
         compress_warmup_epochs=1),
     "choco-resnet-cifar10-64w-512shard": dict(
@@ -242,6 +261,15 @@ CONVERGE_OVERRIDES = {
         _CONVERGE_DATA, epochs=12, consensus_lr=0.1,
         compress_warmup_epochs=4,
         dataset_kwargs={"num_train": 16384, "num_test": 256,
+                        "separation": 40.0}),
+    # same data/shards and the same 4-epoch ratio ramp as the warmup-quick
+    # A/B arm (the setup where dense gossip reaches 0.9513 and
+    # MATCHA-scheduled CHOCO stalls at 0.135) — only the schedule differs:
+    # fixed all-matchings W every step
+    "choco-resnet-cifar10-64w-fixed": dict(
+        _CONVERGE_DATA, epochs=12, batch_size=4, consensus_lr=0.1,
+        compress_warmup_epochs=4,
+        dataset_kwargs={"num_train": 4096, "num_test": 256,
                         "separation": 40.0}),
     # 512 images/worker, same step budget per image (epochs scale down is
     # NOT applied: more steps is the point of bigger shards)

@@ -165,8 +165,9 @@ def photo_patches(
     of the reference's CIFAR conv-net configs (util.py:117-149): one class
     per distinct real photograph baked into site-packages, ``patch²`` RGB
     crops sampled from it.  Train and test crops come from spatially
-    DISJOINT image regions (train: left 70% of the width; test: right 30%,
-    with a full patch-width gap) so test accuracy measures generalization to
+    DISJOINT, adjacent image regions — train pixels end at column
+    ``split−1``, test pixels start at column ``split`` (no shared pixel,
+    but no gap either) — so test accuracy measures generalization to
     unseen pixels of the scene, not crop memorization.  Raw [0,1] pixels are
     standardized with the fixed ``photo_patches`` constants.
 

@@ -213,6 +213,42 @@ class FoldedPlan:
     def offsets_used(self) -> List[List[int]]:
         return [[p.offset for p in m] for m in self.matchings]
 
+    def hop_accounting(self) -> List[List[Tuple[int, int, int]]]:
+        """Per-matching ``(offset, slots, ring_hops)`` cost ledger.
+
+        One entry per offset part: ``slots`` is how many of the N worker
+        slots that part serves (mask population — fixed points land in the
+        offset-0 part), and ``ring_hops`` is what the part's ``ppermute``
+        costs on a bidirectional ICI ring: ``min(d, C − d)`` sequential hops
+        for the whole ``[L, ...]`` block, 0 for the chip-local part.  This is
+        the per-edge accounting the offline planner's link-cost model sums —
+        exposed here, next to the execution plan it describes, so the cost
+        model can never drift from what ``gossip_mix_folded`` actually runs.
+        """
+        C = self.num_chips
+        out: List[List[Tuple[int, int, int]]] = []
+        for parts in self.matchings:
+            out.append([
+                (p.offset, int(p.mask.sum()), min(p.offset, C - p.offset))
+                for p in parts
+            ])
+        return out
+
+    def matching_hop_units(self) -> np.ndarray:
+        """f64[M] — total ring hops each matching costs per activation.
+
+        The folded executor issues one ``ppermute`` per (matching, nonzero
+        offset) regardless of how many edges share the offset, so the unit is
+        hops-of-a-full-block, summed over the matching's nonzero offsets.
+        All-local matchings (and any plan at C = 1) cost 0 — matching the
+        measured single-chip regime where comm_time is flat across budgets
+        (benchmarks/budget_sweep.json).
+        """
+        return np.asarray(
+            [sum(h for (_, _, h) in m) for m in self.hop_accounting()],
+            dtype=np.float64,
+        )
+
 
 def build_folded_plan(perms: np.ndarray, num_chips: int) -> FoldedPlan:
     """Split each matching permutation into intra-chip and inter-chip parts.
@@ -302,13 +338,25 @@ def gossip_mix_folded(
     return x_blk + acc
 
 
+def import_shard_map():
+    """``jax.shard_map``, wherever this jax version keeps it (it moved out
+    of ``jax.experimental`` in 0.5) — the one shim every shard_map backend
+    shares."""
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it under experimental
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
 def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS,
                         skip: bool = False):
     """Build a jittable ``(x[N,...], weights[M]) -> x[N,...]`` gossip function
     running as an explicit shard_map over ``mesh``.  ``skip`` forwards to
     :func:`gossip_mix_folded` (cond-skip inactive matchings' collectives)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    shard_map = import_shard_map()
 
     C = mesh.shape[axis]
     plan = build_folded_plan(np.asarray(perms), C)

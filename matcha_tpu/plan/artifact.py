@@ -1,0 +1,102 @@
+"""The plan artifact: a JSON contract between offline planning and training.
+
+``plan_tpu.py sweep`` writes one; ``train_tpu.py --plan plan.json`` consumes
+it.  The artifact pre-resolves everything the schedule builder needs —
+graph selection, budget, and the flag-stream seed — so a training run driven
+by a plan builds *exactly* the schedule the planner scored (the builders are
+deterministic in those inputs; ``tests/test_plan.py`` pins fingerprint
+equality with the equivalent explicit flags).  The solver outputs the planner
+observed (α, activation probabilities, ρ) are recorded for provenance and
+re-derived at train time, never injected: a stale artifact can mispredict,
+but it cannot desynchronize gossip from its solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+__all__ = ["PLAN_FORMAT", "PlanArtifact", "save_plan", "load_plan",
+           "apply_plan"]
+
+PLAN_FORMAT = "matcha_tpu.plan/1"
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanArtifact:
+    """A ranked schedule-planning result.
+
+    ``chosen`` / each entry of ``candidates`` is a flat dict with the keys
+    produced by :func:`matcha_tpu.plan.autotune.plan_candidate`:
+    graph spec (``graphid``/``topology``/``num_workers``), ``budget``,
+    ``seed``, solver outputs (``alpha``, ``probs``, ``rho``), and the
+    predictions (``expected_comm_units``, ``steps_to_target``,
+    ``predicted_step_s``, ``predicted_seconds_to_target``).
+    """
+
+    chosen: dict
+    candidates: List[dict]
+    target_consensus: float
+    num_chips: int
+    cost_model: dict
+    format: str = PLAN_FORMAT
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "PlanArtifact":
+        fmt = d.get("format", "")
+        if fmt != PLAN_FORMAT:
+            raise ValueError(
+                f"unsupported plan format {fmt!r} (expected {PLAN_FORMAT!r})"
+            )
+        return PlanArtifact(
+            chosen=dict(d["chosen"]),
+            candidates=[dict(c) for c in d.get("candidates", [])],
+            target_consensus=float(d["target_consensus"]),
+            num_chips=int(d["num_chips"]),
+            cost_model=dict(d.get("cost_model", {})),
+            format=fmt,
+        )
+
+
+def save_plan(artifact: PlanArtifact, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(artifact.to_json(), f, indent=1)
+
+
+def load_plan(path: str) -> PlanArtifact:
+    with open(path) as f:
+        return PlanArtifact.from_json(json.load(f))
+
+
+def apply_plan(config, artifact: Optional[PlanArtifact] = None):
+    """Resolve a ``TrainConfig`` against its plan artifact.
+
+    Returns a new config whose schedule-determining fields — graph selection,
+    worker count, budget, MATCHA mode, and seed — come from the artifact's
+    chosen candidate.  Everything else (model, data, optimizer, backend)
+    stays the caller's.  The plan wins over any explicitly-passed schedule
+    flags by design: the artifact exists to make the schedule choice a
+    reviewed, committed input rather than a per-invocation knob.
+
+    With ``artifact=None`` the plan is loaded from ``config.plan`` (no-op
+    when that is unset) — the hook :func:`matcha_tpu.train.train` calls, so
+    CLI and programmatic runs share one resolution path.
+    """
+    if artifact is None:
+        if not getattr(config, "plan", None):
+            return config
+        artifact = load_plan(config.plan)
+    c = artifact.chosen
+    return dataclasses.replace(
+        config,
+        graphid=c.get("graphid"),
+        topology=c.get("topology") or config.topology,
+        num_workers=int(c["num_workers"]),
+        matcha=bool(c.get("matcha", True)),
+        budget=float(c["budget"]),
+        seed=int(c["seed"]),
+    )

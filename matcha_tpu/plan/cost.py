@@ -1,0 +1,143 @@
+"""Link-cost model: predict per-iteration communication cost offline.
+
+The unit of account is the **ring hop**: one ``lax.ppermute`` of a chip's
+``[L, ...]`` state block moving ``min(d, C−d)`` hops around the bidirectional
+ICI ring.  That is exactly what the folded executor issues per (matching,
+nonzero chip-offset) — the accounting comes straight from
+``FoldedPlan.hop_accounting`` (``parallel/gossip.py``), so the model cannot
+drift from the execution plan.
+
+Expected per-iteration cost of a schedule is then linear in the activation
+probabilities:
+
+    E[cost] = Σ_j p_j · hops_j        (hop-weighted units / iteration)
+
+Converting units to seconds needs two calibration constants — a fixed
+per-iteration overhead ``c₀`` (dispatch, on-chip gather/FMA work, which the
+single-chip measurements show dominates) and a per-hop-unit time ``c₁`` —
+fit by least squares from measured ``(units, seconds)`` pairs, e.g. the
+committed ``benchmarks/budget_sweep.json`` comm timings or any
+``BENCH_*.json`` record.  On one chip every matching is local (``hops ≡ 0``)
+and the fit collapses to ``c₀ = mean(measured)`` with ``c₁`` unidentifiable —
+the honest answer for that regime (comm_time flat across budgets, which is
+what the committed sweep shows); the hop term prices the folded multi-chip
+plans the north star targets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..parallel.gossip import build_folded_plan
+from ..topology import matchings_to_perms
+
+__all__ = [
+    "CostModel",
+    "matching_comm_units",
+    "expected_comm_units",
+    "calibrate_cost_model",
+    "load_measured_comm_times",
+]
+
+
+def matching_comm_units(
+    decomposed: Sequence[Sequence[tuple]],
+    size: int,
+    num_chips: int = 1,
+    perms: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """f64[M] hop-weighted cost of activating each matching once.
+
+    Workers fold chip-major onto ``num_chips`` devices (the
+    ``build_folded_plan`` layout); each matching costs the sum of ring hops
+    of its distinct nonzero chip offsets.  ``num_chips=1`` → all zeros (every
+    edge is chip-local).
+    """
+    if perms is None:
+        perms = matchings_to_perms([list(m) for m in decomposed], size)
+    plan = build_folded_plan(np.asarray(perms), num_chips)
+    return plan.matching_hop_units()
+
+
+def expected_comm_units(probs: np.ndarray, unit_costs: np.ndarray) -> float:
+    """E[per-iteration hop units] = Σ_j p_j · hops_j (flags are Bernoulli)."""
+    p = np.asarray(probs, dtype=np.float64)
+    u = np.asarray(unit_costs, dtype=np.float64)
+    if p.shape != u.shape:
+        raise ValueError(f"probs {p.shape} vs unit costs {u.shape}")
+    return float(p @ u)
+
+
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """Seconds per gossip iteration as an affine function of hop units.
+
+    ``seconds(units) = base_step_s + per_hop_s · units``.  The defaults are
+    unit-free (base 1, hop 1): rankings by predicted cost are then rankings
+    by ``1 + units`` — already correct ordinally — and calibration only
+    sharpens the *ratio* between topology choices into wall-clock.
+    """
+
+    base_step_s: float = 1.0
+    per_hop_s: float = 1.0
+    source: str = "uncalibrated"
+
+    def step_seconds(self, units: float) -> float:
+        return self.base_step_s + self.per_hop_s * float(units)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "CostModel":
+        return CostModel(base_step_s=float(d["base_step_s"]),
+                         per_hop_s=float(d["per_hop_s"]),
+                         source=str(d.get("source", "uncalibrated")))
+
+
+def calibrate_cost_model(
+    samples: Sequence[Tuple[float, float]], source: str = "measured"
+) -> CostModel:
+    """Least-squares fit of ``(units, seconds)`` pairs to the affine model.
+
+    Degenerate designs are handled the way the physics demands: with a
+    single distinct units value (e.g. every sample at 0 — the single-chip
+    regime) the slope is unidentifiable, so ``per_hop_s = 0`` and the base
+    absorbs the mean.  Negative fitted coefficients are clamped to 0: a
+    negative marginal hop cost is measurement noise, and propagating it
+    would rank *more* communication as *faster*.
+    """
+    if not samples:
+        raise ValueError("need at least one (units, seconds) sample")
+    units = np.asarray([s[0] for s in samples], dtype=np.float64)
+    secs = np.asarray([s[1] for s in samples], dtype=np.float64)
+    if np.ptp(units) < 1e-12:
+        return CostModel(base_step_s=float(secs.mean()), per_hop_s=0.0,
+                         source=source + " (slope unidentifiable: "
+                                         "single units level)")
+    A = np.stack([np.ones_like(units), units], axis=1)
+    (c0, c1), *_ = np.linalg.lstsq(A, secs, rcond=None)
+    c0, c1 = max(float(c0), 0.0), max(float(c1), 0.0)
+    return CostModel(base_step_s=c0, per_hop_s=c1, source=source)
+
+
+def load_measured_comm_times(path: str) -> list:
+    """Extract ``(budget, comm_seconds_per_epoch)`` pairs from a committed
+    ``budget_sweep.json`` summary — the calibration input
+    ``plan_tpu.py sweep --calibrate`` accepts.  Returns
+    ``[(budget, seconds), ...]`` for the MATCHA runs (the D-PSGD row has no
+    budget semantics)."""
+    with open(path) as f:
+        summary = json.load(f)
+    out = []
+    for run in summary.get("runs", []):
+        if run.get("algorithm") == "matcha":
+            out.append((float(run["budget"]),
+                        float(run["mean_comm_time_per_epoch"])))
+    if not out:
+        raise ValueError(f"no MATCHA runs with comm timings in {path}")
+    return out

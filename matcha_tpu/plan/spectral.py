@@ -1,0 +1,171 @@
+"""Consensus-decay prediction: closed-form bound and Monte-Carlo simulation.
+
+Two views of the same quantity, deliberately kept side by side:
+
+* **Closed form** — ``ρ = λ_max(I − J − 2α·E[L] + α²(E[L]² + 2·Var[L]))``,
+  the bound the MATCHA SDP minimizes (``topology.expected_contraction_rate``).
+  It bounds the *expected* one-step squared consensus error:
+  ``E‖W_t x − x̄‖² ≤ ρ·‖x − x̄‖²``.
+
+* **Monte Carlo** — sample the actual Bernoulli flag stream
+  (``schedule.base.sample_flags``, the exact generator training uses) and
+  apply the realized ``W_t`` products to synthetic vectors.  This tracks the
+  full time-varying trajectory, cross-terms included — the structure the r5
+  CHOCO investigation showed matters (a product of *different* ``W_t`` is not
+  the product of their expectations; see README "CHOCO-at-64-workers root
+  cause").  For plain gossip the realized geometric rate sits *below* the
+  bound (Jensen: the geometric mean of the per-step ratios is ≤ their
+  arithmetic mean, whose expectation ρ bounds); the simulator is what makes
+  that gap measurable per topology instead of assumed.
+
+Numerics: consensus error decays geometrically, so a long trajectory
+underflows f64 within a few hundred steps at ρ ≈ 0.4.  The simulator
+renormalizes the consensus component to unit norm every step and accumulates
+``log`` ratios instead — exact for a linear recurrence, stable for any
+horizon.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..schedule.base import sample_flags
+from ..schedule.solvers import contraction_rho
+from ..topology import matching_laplacians
+
+__all__ = [
+    "ConsensusSim",
+    "simulate_consensus",
+    "empirical_contraction_rate",
+    "steps_to_consensus",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusSim:
+    """Result of a Monte-Carlo consensus simulation.
+
+    ``log_errors``: f64[trials, steps+1] — log of the squared consensus error
+    ``‖x_t − x̄‖²`` per trial, starting from log(1) = 0 (trajectories are
+    normalized to unit initial consensus error so trials are comparable).
+    ``rho_bound``: the closed-form expectation bound for the same
+    (laplacians, probs, alpha).
+    """
+
+    log_errors: np.ndarray  # f64[trials, steps+1], natural log of ‖x−x̄‖²
+    rho_bound: float
+    alpha: float
+
+    @property
+    def steps(self) -> int:
+        return int(self.log_errors.shape[1]) - 1
+
+    @property
+    def trials(self) -> int:
+        return int(self.log_errors.shape[0])
+
+    def empirical_rate(self) -> float:
+        """Geometric-mean per-step contraction of the squared error."""
+        return empirical_contraction_rate(self.log_errors)
+
+    def mean_decay_curve(self) -> np.ndarray:
+        """f64[steps+1] — trial-averaged squared-error curve, log-domain mean
+        (i.e. the geometric mean across trials, which is what a geometric
+        process concentrates around)."""
+        return np.exp(self.log_errors.mean(axis=0))
+
+    def predicted_bound_curve(self) -> np.ndarray:
+        """f64[steps+1] — the closed-form curve ρ^t the trajectory must
+        (in expectation) stay under."""
+        return self.rho_bound ** np.arange(self.steps + 1, dtype=np.float64)
+
+
+def _consensus_component(x: np.ndarray) -> np.ndarray:
+    return x - x.mean(axis=0, keepdims=True)
+
+
+def simulate_consensus(
+    decomposed: Sequence[Sequence[tuple]],
+    size: int,
+    probs: np.ndarray,
+    alpha: float,
+    steps: int = 80,
+    trials: int = 8,
+    dim: int = 4,
+    seed: int = 0,
+    laplacians: Optional[np.ndarray] = None,
+) -> ConsensusSim:
+    """Simulate ``x ← W_t x`` under sampled Bernoulli activation flags.
+
+    Each trial draws its own flag stream (``seed + trial`` — the same
+    counter-free generator ``Schedule`` uses, so the statistics match
+    training exactly) and its own Gaussian start ``x₀ ∈ R^{size×dim}``.
+    ``dim`` independent columns per trial cheapen the variance reduction:
+    the consensus error sums over columns, so one trial already averages
+    ``dim`` random directions.
+    """
+    if laplacians is None:
+        laplacians = matching_laplacians(decomposed, size)
+    Ls = np.asarray(laplacians, dtype=np.float64)
+    p = np.asarray(probs, dtype=np.float64)
+    eye = np.eye(size)
+
+    log_errors = np.zeros((trials, steps + 1), dtype=np.float64)
+    for trial in range(trials):
+        rng = np.random.default_rng(seed * 7919 + trial)
+        flags = sample_flags(p, steps, seed=seed * 7919 + trial)
+        x = _consensus_component(rng.standard_normal((size, dim)))
+        norm = math.sqrt(float(np.sum(x * x)))
+        x /= max(norm, 1e-300)
+        log_e = 0.0
+        for t in range(steps):
+            W = eye - alpha * np.tensordot(
+                flags[t].astype(np.float64), Ls, axes=1
+            )
+            x = _consensus_component(W @ x)  # re-project: guards fp drift
+            e = float(np.sum(x * x))  # ‖x − x̄‖² of the unit-normalized state
+            log_e += math.log(max(e, 1e-300))
+            log_errors[trial, t + 1] = log_e
+            x /= max(math.sqrt(e), 1e-300)  # renormalize: no underflow ever
+    rho = contraction_rho(Ls, p, float(alpha))
+    return ConsensusSim(log_errors=log_errors, rho_bound=float(rho),
+                        alpha=float(alpha))
+
+
+def empirical_contraction_rate(log_errors: np.ndarray) -> float:
+    """Per-step geometric-mean contraction of ‖x − x̄‖² from log trajectories.
+
+    ``exp(mean over trials of (log e_T − log e_0) / T)``.  By Jensen this is
+    ≤ the arithmetic-mean per-step ratio, whose expectation the closed-form ρ
+    bounds — so ``empirical ≤ ρ`` holds in expectation, with O(1/√trials)
+    sampling noise on the log scale (the tolerance tests must budget for).
+    """
+    log_errors = np.asarray(log_errors, dtype=np.float64)
+    T = log_errors.shape[1] - 1
+    if T < 1:
+        raise ValueError("need at least one simulated step")
+    per_trial = (log_errors[:, -1] - log_errors[:, 0]) / T
+    return float(np.exp(per_trial.mean()))
+
+
+def steps_to_consensus(rho: float, target: float = 1e-3) -> float:
+    """Predicted iterations for the squared consensus error to shrink by
+    ``target`` under the bound ``e_t ≤ ρ^t e_0``.
+
+    Returns ``inf`` when ρ ≥ 1 (no contraction — the budget is below the
+    connectivity threshold of the expected graph) and 0 when the target is
+    already met at t = 0.  Fractional steps are kept: the autotuner ranks by
+    the product ``steps × step-time``, where rounding would quantize away
+    real differences between nearby budgets.
+    """
+    if not 0 < target < 1:
+        raise ValueError(f"target must be in (0, 1), got {target}")
+    if rho >= 1.0:
+        return math.inf
+    if rho <= 0.0:
+        return 1.0  # one step annihilates the consensus error (complete graph)
+    return math.log(target) / math.log(rho)

@@ -53,6 +53,10 @@ class TrainConfig:
     budget: float = 0.5
     fixed_mode: str = "all"  # D-PSGD flag mode: all|bernoulli|alternating
     seed: int = 9001  # reference --randomSeed default (train_mpi.py:230)
+    # path to a plan_tpu.py artifact: resolves graph/budget/seed offline
+    # (matcha_tpu.plan.apply_plan overrides those fields at train() entry,
+    # so the schedule built is exactly the one the planner scored)
+    plan: Optional[str] = None
 
     # communicator (reference: --compress/--consensus_lr; ratio was hard-coded)
     communicator: str = "decen"  # decen|choco|centralized|none

@@ -115,6 +115,13 @@ class TrainResult:
 
 
 def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
+    if config.plan:
+        # resolve the plan artifact's schedule choice (graph, budget, seed)
+        # into the config before anything downstream reads those fields —
+        # one path for CLI (--plan) and programmatic (TrainConfig(plan=...))
+        from ..plan import apply_plan
+
+        config = apply_plan(config)
     dataset = build_dataset(config)
     parts = partition_indices(
         len(dataset.x_train), config.num_workers, seed=config.seed,

@@ -35,19 +35,31 @@ def user_cache_dir(sub: str) -> str:
             uid = os.getuid() if hasattr(os, "getuid") else "na"
             base = os.path.join(tempfile.gettempdir(), f"matcha_cache_u{uid}")
             tmp_fallback = True
-    path = os.path.join(base, "matcha_tpu", sub)
-    os.makedirs(path, mode=0o700, exist_ok=True)
     if tmp_fallback and hasattr(os, "getuid"):
-        # a pre-existing dir under world-writable tempdir may be another
+        # a pre-existing entry under world-writable tempdir may be another
         # user's plant (exist_ok accepts it silently, and makedirs never
-        # re-modes an existing leaf): insist on ownership + 0700
-        st = os.stat(base)
+        # re-modes an existing leaf).  Validate with lstat BEFORE creating
+        # anything beneath it: os.stat would follow a pre-created symlink
+        # into a victim-owned directory and pass the uid check while
+        # redirecting every cache write (ADVICE r5).  Insist on a real
+        # directory we own, mode 0700.
+        import stat as stat_mod
+
+        os.makedirs(base, mode=0o700, exist_ok=True)  # no-op if planted
+        st = os.lstat(base)
+        if stat_mod.S_ISLNK(st.st_mode) or not stat_mod.S_ISDIR(st.st_mode):
+            raise RuntimeError(
+                f"cache dir {base} is a symlink or non-directory — refusing "
+                "a possibly planted cache path; set XDG_CACHE_HOME to a "
+                "private location")
         if st.st_uid != os.getuid():
             raise RuntimeError(
                 f"cache dir {base} is owned by uid {st.st_uid}, not "
                 f"{os.getuid()} — refusing a possibly planted cache; set "
                 "XDG_CACHE_HOME to a private location")
         os.chmod(base, 0o700)
+    path = os.path.join(base, "matcha_tpu", sub)
+    os.makedirs(path, mode=0o700, exist_ok=True)
     return path
 
 

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Offline schedule planner CLI — pick the MATCHA budget *before* training.
+
+Subcommands
+-----------
+``rho``     closed-form contraction bound + Monte-Carlo empirical rate for
+            one (topology, budget) point::
+
+                python plan_tpu.py rho --graphid 2 --budget 0.5 --mc-trials 8
+
+``cost``    per-matching hop-cost ledger for a folded multi-chip layout::
+
+                python plan_tpu.py cost --graphid 2 --chips 4
+
+``sweep``   budgets × topologies, ranked by predicted wall-clock to target
+            consensus; writes the plan artifact train_tpu.py consumes::
+
+                python plan_tpu.py sweep --graphid 2 \
+                    --budgets 0.1,0.25,0.5,1.0 --out plan.json
+                python train_tpu.py --plan plan.json --model resnet20 ...
+
+            ``--calibrate benchmarks/budget_sweep.json`` fits the cost model
+            from a committed measurement table instead of unit costs.
+
+``verify``  compare a plan's predicted disagreement decay against the
+            Recorder CSVs of a real run::
+
+                python plan_tpu.py verify --plan plan.json \
+                    --run-dir runs/myrun_resnet20 --steps-per-epoch 32
+
+Everything here is host-side numpy/scipy — no JAX, no accelerator; a laptop
+plans for a pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from matcha_tpu.plan import (
+    CostModel,
+    calibrate_cost_model,
+    expected_comm_units,
+    load_measured_comm_times,
+    load_plan,
+    matching_comm_units,
+    plan_candidate,
+    resolve_topology,
+    save_plan,
+    simulate_consensus,
+    sweep,
+    verify_plan_run,
+)
+
+
+def _add_topology_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--graphid", type=int, default=None,
+                   help="zoo topology id (0-5); omit to use --topology")
+    p.add_argument("--topology", default=None,
+                   help="generator kind (ring|torus|erdos_renyi|geometric|...)")
+    p.add_argument("--numworkers", type=int, default=16,
+                   help="worker count for generator topologies")
+    p.add_argument("--seed", type=int, default=9001,
+                   help="graph-generation and flag-stream seed "
+                        "(train_tpu.py --randomSeed equivalent)")
+
+
+def _topology_specs(args) -> list:
+    if args.graphid is not None:
+        return [{"graphid": args.graphid}]
+    if args.topology:
+        return [{"topology": args.topology, "num_workers": args.numworkers}]
+    raise SystemExit("pass --graphid or --topology")
+
+
+def _cost_model(args) -> CostModel:
+    if getattr(args, "calibrate", None):
+        from matcha_tpu.schedule.solvers import solve_activation_probabilities
+        from matcha_tpu.topology import matching_laplacians
+
+        # The measured seconds come from whatever (topology, chips) the
+        # calibration file's runs used; pairing them with THIS plan's
+        # predicted hop units is only a valid fit when the two match.  The
+        # sweep summary doesn't record its graph, so this is an assumption
+        # the caller owns — say so instead of fitting silently.
+        print(f"# calibrating from {args.calibrate}: assumes its runs used "
+              f"the topology/--chips being planned here", file=sys.stderr)
+        samples = []
+        for spec in _topology_specs(args):
+            decomposed, size, _ = resolve_topology(spec, args.seed)
+            Ls = matching_laplacians(decomposed, size)
+            units_of = matching_comm_units(decomposed, size, args.chips)
+            for budget, seconds in load_measured_comm_times(args.calibrate):
+                probs = solve_activation_probabilities(
+                    Ls, budget, iters=args.solver_iters)
+                samples.append(
+                    (expected_comm_units(probs, units_of), seconds))
+        return calibrate_cost_model(samples, source=args.calibrate)
+    return CostModel()
+
+
+def cmd_rho(args) -> int:
+    (spec,) = _topology_specs(args)
+    decomposed, size, norm = resolve_topology(spec, args.seed)
+    cand = plan_candidate(
+        decomposed, size, args.budget, seed=args.seed, target=args.target,
+        num_chips=args.chips, solver_iters=args.solver_iters,
+        mc_trials=args.mc_trials, mc_steps=args.mc_steps, graph_spec=norm)
+    print(json.dumps(cand, indent=1))
+    return 0
+
+
+def cmd_cost(args) -> int:
+    (spec,) = _topology_specs(args)
+    decomposed, size, norm = resolve_topology(spec, args.seed)
+    from matcha_tpu.parallel.gossip import build_folded_plan
+    from matcha_tpu.topology import matchings_to_perms
+
+    plan = build_folded_plan(matchings_to_perms(decomposed, size), args.chips)
+    print(json.dumps({
+        **norm,
+        "num_chips": args.chips,
+        "rows_per_chip": plan.rows_per_chip,
+        "per_matching": [
+            {"matching": j,
+             "parts": [{"offset": o, "slots": s, "ring_hops": h}
+                       for (o, s, h) in parts],
+             "hop_units": float(sum(h for (_, _, h) in parts))}
+            for j, parts in enumerate(plan.hop_accounting())
+        ],
+    }, indent=1))
+    return 0
+
+
+def cmd_sweep(args) -> int:
+    budgets = [float(b) for b in args.budgets.split(",")]
+    artifact = sweep(
+        _topology_specs(args), budgets, seed=args.seed, target=args.target,
+        num_chips=args.chips, cost_model=_cost_model(args),
+        solver_iters=args.solver_iters, mc_trials=args.mc_trials,
+        mc_steps=args.mc_steps)
+    save_plan(artifact, args.out)
+    best = artifact.chosen
+    print(f"# wrote {args.out}", file=sys.stderr)
+    print(json.dumps({
+        "chosen_budget": best["budget"],
+        "rho": best["rho"],
+        "steps_to_target": best["steps_to_target"],
+        "predicted_seconds_to_target": best["predicted_seconds_to_target"],
+        "ranking": [
+            {"budget": c["budget"], "rho": c["rho"],
+             "predicted_seconds_to_target": c["predicted_seconds_to_target"]}
+            for c in artifact.candidates
+        ],
+    }, indent=1))
+    return 0
+
+
+def cmd_verify(args) -> int:
+    artifact = load_plan(args.plan)
+    report = verify_plan_run(artifact, args.run_dir, args.steps_per_epoch,
+                             rank=args.rank)
+    print(json.dumps(report, indent=1))
+    return 0 if report["consistent"] else 1
+
+
+def cmd_simulate(args) -> int:
+    (spec,) = _topology_specs(args)
+    decomposed, size, norm = resolve_topology(spec, args.seed)
+    from matcha_tpu.schedule.solvers import (
+        solve_activation_probabilities,
+        solve_mixing_weight,
+    )
+    from matcha_tpu.topology import matching_laplacians
+
+    Ls = matching_laplacians(decomposed, size)
+    probs = solve_activation_probabilities(Ls, args.budget,
+                                           iters=args.solver_iters)
+    alpha, rho = solve_mixing_weight(Ls, probs)
+    sim = simulate_consensus(decomposed, size, probs, alpha,
+                             steps=args.mc_steps, trials=args.mc_trials,
+                             seed=args.seed, laplacians=Ls)
+    print(json.dumps({
+        **norm, "budget": args.budget, "alpha": alpha,
+        "rho_bound": sim.rho_bound,
+        "mc_empirical_rate": sim.empirical_rate(),
+        "mean_decay_curve": [float(v) for v in sim.mean_decay_curve()],
+        "predicted_bound_curve": [float(v)
+                                  for v in sim.predicted_bound_curve()],
+    }, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    common = dict(target=1e-3, chips=1, solver_iters=3000)
+
+    def add_common(sp, mc_default=0):
+        _add_topology_args(sp)
+        sp.add_argument("--target", type=float, default=common["target"],
+                        help="consensus-error contraction target (squared)")
+        sp.add_argument("--chips", type=int, default=common["chips"],
+                        help="fold N workers onto this many chips for the "
+                             "hop-cost model")
+        sp.add_argument("--solver-iters", type=int,
+                        default=common["solver_iters"], dest="solver_iters")
+        sp.add_argument("--mc-trials", type=int, default=mc_default,
+                        dest="mc_trials",
+                        help="Monte-Carlo trials (0 = closed form only)")
+        sp.add_argument("--mc-steps", type=int, default=80, dest="mc_steps")
+
+    sp = sub.add_parser("rho", help="contraction bound for one point")
+    add_common(sp)
+    sp.add_argument("--budget", type=float, default=0.5)
+    sp.set_defaults(fn=cmd_rho)
+
+    sp = sub.add_parser("simulate", help="Monte-Carlo consensus trajectory")
+    add_common(sp, mc_default=8)
+    sp.add_argument("--budget", type=float, default=0.5)
+    sp.set_defaults(fn=cmd_simulate)
+
+    sp = sub.add_parser("cost", help="per-matching hop-cost ledger")
+    _add_topology_args(sp)
+    sp.add_argument("--chips", type=int, default=4)
+    sp.set_defaults(fn=cmd_cost)
+
+    sp = sub.add_parser("sweep", help="rank budgets, write the plan artifact")
+    add_common(sp)
+    sp.add_argument("--budgets", default="0.1,0.25,0.5,1.0")
+    sp.add_argument("--out", default="plan.json")
+    sp.add_argument("--calibrate", default=None,
+                    help="budget_sweep.json to fit the cost model from; its "
+                         "runs must come from the same topology and --chips "
+                         "being planned, or the fit is meaningless")
+    sp.set_defaults(fn=cmd_sweep)
+
+    sp = sub.add_parser("verify", help="plan vs a real run's Recorder CSVs")
+    sp.add_argument("--plan", required=True)
+    sp.add_argument("--run-dir", required=True, dest="run_dir")
+    sp.add_argument("--steps-per-epoch", type=int, required=True,
+                    dest="steps_per_epoch")
+    sp.add_argument("--rank", type=int, default=0)
+    sp.set_defaults(fn=cmd_verify)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,8 +9,20 @@ which takes effect because backends initialize lazily."""
 import os
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# 8 virtual CPU devices: the config option only exists on newer jax, and the
+# XLA flag only works on older jax — set both, before first backend init
+# (XLA_FLAGS is read lazily at CPU-backend creation, so this is early enough
+# even though jax itself may already be imported by sitecustomize).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # jax < 0.5: the XLA_FLAGS path above applies
+    pass

@@ -1,0 +1,354 @@
+"""The offline planner (`matcha_tpu.plan` + plan_tpu.py).
+
+Covers the ISSUE-2 acceptance criteria:
+
+* closed-form ρ vs Monte-Carlo agreement on every zoo topology at budgets
+  {0.1, 0.25, 0.5, 1.0} (empirical rate ≤ bound; tolerance documented at the
+  assertion),
+* cost-model monotonicity in budget,
+* plan-artifact round-trip through ``train_tpu.py --plan`` (same schedule
+  fingerprint — and same trained parameters — as the equivalent explicit
+  flags),
+* ``plan verify`` against a committed Recorder CSV fixture
+  (tests/fixtures/recorder_mini, produced by the exact config in its
+  ExpDescription),
+* sweep ranking consistency with the committed benchmarks/budget_sweep.json.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+from matcha_tpu.plan import (
+    CostModel,
+    PlanArtifact,
+    apply_plan,
+    calibrate_cost_model,
+    expected_comm_units,
+    load_plan,
+    load_recorder_disagreement,
+    matching_comm_units,
+    plan_candidate,
+    save_plan,
+    simulate_consensus,
+    steps_to_consensus,
+    sweep,
+    verify_against_recorder,
+    verify_plan_run,
+)
+from matcha_tpu.schedule.solvers import (
+    solve_activation_probabilities,
+    solve_mixing_weight,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGETS = (0.1, 0.25, 0.5, 1.0)
+
+
+# ------------------------------------------------------------- spectral sim
+
+def test_mc_agrees_with_closed_form_bound_across_zoo():
+    """Acceptance: for every zoo topology × budget, the Monte-Carlo per-step
+    contraction of ‖x − x̄‖² stays at or under the closed-form ρ bound.
+
+    Tolerance: the empirical rate is a geometric mean, which Jensen places
+    *below* the arithmetic-mean ratio that ρ bounds, so the expected margin
+    is negative; 2% multiplicative headroom covers finite-sample noise of
+    6 trials × 60 steps (measured margins across the zoo are 1–15% below
+    the bound, so 2% is slack on top of slack, not a fudge that could mask
+    a real violation).
+    """
+    for gid in range(6):
+        size = tp.graph_size(gid)
+        dec = tp.select_graph(gid)
+        Ls = tp.matching_laplacians(dec, size)
+        for budget in BUDGETS:
+            p = solve_activation_probabilities(Ls, budget, iters=600)
+            alpha, rho = solve_mixing_weight(Ls, p)
+            sim = simulate_consensus(dec, size, p, alpha, steps=60, trials=6,
+                                     seed=3, laplacians=Ls)
+            emp = sim.empirical_rate()
+            assert emp <= rho * 1.02, (gid, budget, emp, rho)
+            if rho < 1.0:  # contractive schedule must actually contract
+                assert emp < 1.0, (gid, budget, emp, rho)
+
+
+def test_simulation_trajectory_shape_and_curves():
+    dec = tp.select_graph(5)
+    p = np.full(2, 0.5)
+    alpha, rho = solve_mixing_weight(tp.matching_laplacians(dec, 8), p)
+    sim = simulate_consensus(dec, 8, p, alpha, steps=30, trials=4, seed=0)
+    assert sim.log_errors.shape == (4, 31)
+    assert sim.steps == 30 and sim.trials == 4
+    curve = sim.mean_decay_curve()
+    bound = sim.predicted_bound_curve()
+    assert curve.shape == bound.shape == (31,)
+    assert curve[0] == pytest.approx(1.0)
+    assert bound[0] == pytest.approx(1.0)
+    # trajectories are monotone-ish decays; endpoint respects the bound
+    assert curve[-1] <= bound[-1] * 1.05
+
+
+def test_simulation_deterministic_in_seed():
+    dec = tp.select_graph(0)
+    Ls = tp.matching_laplacians(dec, 8)
+    p = solve_activation_probabilities(Ls, 0.5, iters=300)
+    alpha, _ = solve_mixing_weight(Ls, p)
+    s1 = simulate_consensus(dec, 8, p, alpha, steps=20, trials=3, seed=11)
+    s2 = simulate_consensus(dec, 8, p, alpha, steps=20, trials=3, seed=11)
+    np.testing.assert_array_equal(s1.log_errors, s2.log_errors)
+    s3 = simulate_consensus(dec, 8, p, alpha, steps=20, trials=3, seed=12)
+    assert not np.array_equal(s1.log_errors, s3.log_errors)
+
+
+def test_steps_to_consensus_edge_cases():
+    assert steps_to_consensus(1.0, 1e-3) == float("inf")
+    assert steps_to_consensus(1.5, 1e-3) == float("inf")
+    assert steps_to_consensus(0.0, 1e-3) == 1.0
+    assert steps_to_consensus(0.5, 0.25) == pytest.approx(2.0)
+    with pytest.raises(ValueError):
+        steps_to_consensus(0.5, 1.5)
+    with pytest.raises(ValueError):
+        steps_to_consensus(0.5, 0.0)
+
+
+# ------------------------------------------------------------- cost model
+
+def test_matching_hop_units_ring_hand_check():
+    """Ring-8 folded onto 4 chips (2 rows/chip): the even matching is fully
+    chip-local (0 hops); the odd matching crosses every chip boundary —
+    offsets 1 and 3 (= C−1), one ppermute each, min(d, C−d) = 1 hop apiece.
+    """
+    dec = tp.select_graph(5)
+    units = matching_comm_units(dec, 8, num_chips=4)
+    np.testing.assert_allclose(units, [0.0, 2.0])
+    # single chip: everything is local, regardless of matching structure
+    for gid in range(6):
+        u1 = matching_comm_units(tp.select_graph(gid), tp.graph_size(gid), 1)
+        assert np.all(u1 == 0.0)
+
+
+def test_hop_accounting_partitions_all_slots():
+    """The offset parts of each matching must jointly serve all N worker
+    slots exactly once — the invariant that makes the folded gather == x[π]
+    (and makes the cost ledger complete)."""
+    from matcha_tpu.parallel.gossip import build_folded_plan
+
+    for gid, chips in ((0, 4), (2, 4), (3, 8)):
+        size = tp.graph_size(gid)
+        perms = tp.matchings_to_perms(tp.select_graph(gid), size)
+        plan = build_folded_plan(perms, chips)
+        for parts in plan.hop_accounting():
+            assert sum(slots for (_, slots, _) in parts) == size
+            for offset, _, hops in parts:
+                assert hops == min(offset, chips - offset)
+
+
+def test_expected_comm_units_monotone_in_budget():
+    """More budget ⇒ more expected hop traffic (the cost the autotuner
+    trades against the better ρ) — checked under the *solver's* probability
+    allocation, not just uniform flags."""
+    for gid, chips in ((2, 4), (5, 4), (0, 2)):
+        size = tp.graph_size(gid)
+        dec = tp.select_graph(gid)
+        Ls = tp.matching_laplacians(dec, size)
+        units = matching_comm_units(dec, size, chips)
+        prev = -1.0
+        for b in BUDGETS:
+            p = solve_activation_probabilities(Ls, b, iters=400)
+            u = expected_comm_units(p, units)
+            assert u >= prev - 1e-9, (gid, b, u, prev)
+            prev = u
+
+
+def test_calibrate_cost_model():
+    # affine recovery
+    cm = calibrate_cost_model([(0.0, 2.0), (1.0, 5.0), (2.0, 8.0)])
+    assert cm.base_step_s == pytest.approx(2.0)
+    assert cm.per_hop_s == pytest.approx(3.0)
+    assert cm.step_seconds(4.0) == pytest.approx(14.0)
+    # single-chip regime: all samples at units=0 — slope unidentifiable,
+    # base absorbs the mean (the honest answer, not a crash)
+    cm0 = calibrate_cost_model([(0.0, 0.06), (0.0, 0.07)])
+    assert cm0.per_hop_s == 0.0
+    assert cm0.base_step_s == pytest.approx(0.065)
+    # a negative fitted slope is noise; clamped so more comm never ranks
+    # as faster
+    cmneg = calibrate_cost_model([(0.0, 1.0), (1.0, 0.5)])
+    assert cmneg.per_hop_s == 0.0
+    with pytest.raises(ValueError):
+        calibrate_cost_model([])
+
+
+# ------------------------------------------------------------- autotune
+
+def test_sweep_ranks_and_artifact_roundtrip(tmp_path):
+    art = sweep([{"graphid": 5}], BUDGETS, seed=7, solver_iters=400,
+                mc_trials=2, mc_steps=30)
+    assert len(art.candidates) == 4
+    scores = [c["predicted_seconds_to_target"] for c in art.candidates]
+    finite = [s for s in scores if s is not None]
+    assert finite == sorted(finite)  # best-first
+    assert art.chosen == art.candidates[0]
+    for c in art.candidates:
+        assert c["mc_empirical_rate"] <= c["rho"] * 1.02
+    path = tmp_path / "plan.json"
+    save_plan(art, str(path))
+    back = load_plan(str(path))
+    assert back.chosen == art.chosen
+    assert back.candidates == art.candidates
+    assert back.cost_model == art.cost_model
+    with pytest.raises(ValueError, match="format"):
+        PlanArtifact.from_json({"format": "bogus/9", "chosen": {},
+                                "target_consensus": 1, "num_chips": 1})
+
+
+def test_sweep_ranking_consistent_with_committed_budget_sweep():
+    """Acceptance: the plan artifact's budget ranking must be consistent
+    with the committed budget_sweep.json measurements.
+
+    'Consistent' is defined against what the measurement can resolve: the
+    committed curves differ by single epochs among the three fastest
+    budgets (±1 epoch granularity, one rep), so the checks are (a) the
+    planner's worst-ranked budget is also the measured-slowest to 0.9
+    accuracy, and (b) the planner's chosen budget reaches 0.9 within 2
+    epochs of the measured-fastest — the resolution of the table, not a
+    rank-for-rank match the data cannot support.
+    """
+    path = os.path.join(REPO, "benchmarks", "budget_sweep.json")
+    with open(path) as f:
+        committed = json.load(f)
+    runs = {r["budget"]: r for r in committed["runs"]
+            if r["algorithm"] == "matcha"}
+    assert set(runs) == set(BUDGETS)
+
+    art = sweep([{"graphid": 2}], BUDGETS, seed=1, solver_iters=800)
+    by_budget = {c["budget"]: c for c in art.candidates}
+
+    def epochs_to_target(curve, target=0.9):
+        return next((i for i, a in enumerate(curve) if a >= target),
+                    len(curve))
+
+    measured = {b: epochs_to_target(runs[b]["test_acc_curve"])
+                for b in BUDGETS}
+    predicted = {b: by_budget[b]["steps_to_target"] or float("inf")
+                 for b in BUDGETS}
+    # (a) extremes agree: the budget predicted slowest to consensus is the
+    # budget measured slowest to accuracy
+    assert max(predicted, key=predicted.get) == max(measured, key=measured.get)
+    # (b) the planner's pick is within the table's resolution of the best
+    chosen = art.chosen["budget"]
+    assert measured[chosen] <= min(measured.values()) + 2
+    # and every candidate carries the prediction fields the sweep JSON
+    # now records alongside measurements
+    for c in art.candidates:
+        assert {"rho", "steps_to_target", "expected_comm_units",
+                "predicted_seconds_to_target"} <= set(c)
+
+
+def test_apply_plan_overrides_schedule_fields():
+    from matcha_tpu.train import TrainConfig
+
+    art = sweep([{"graphid": 5}], [0.25], seed=42, solver_iters=200)
+    cfg = TrainConfig(model="mlp", dataset="synthetic", graphid=0,
+                      num_workers=8, budget=0.9, seed=1, matcha=True)
+    out = apply_plan(cfg, art)
+    assert out.graphid == 5 and out.budget == 0.25 and out.seed == 42
+    assert out.num_workers == 8 and out.matcha
+    assert out.model == "mlp" and out.dataset == "synthetic"  # untouched
+    # no plan configured → no-op
+    assert apply_plan(cfg) is cfg
+
+
+# ------------------------------------------------- train_tpu.py --plan e2e
+
+@pytest.mark.slow
+def test_plan_roundtrip_through_train_cli(tmp_path):
+    """Acceptance: ``train_tpu.py --plan artifact`` runs end-to-end using the
+    planner-chosen schedule with *no behavior change* versus the equivalent
+    explicit flags — same schedule fingerprint (what save_checkpoint would
+    write) and bit-identical trained parameters."""
+    import jax
+
+    import train_tpu
+    from matcha_tpu.train import train
+    from matcha_tpu.train.checkpoint import schedule_fingerprint
+
+    art = sweep([{"graphid": 5}], [0.25, 0.5], seed=9001, solver_iters=600)
+    plan_path = tmp_path / "plan.json"
+    save_plan(art, str(plan_path))
+    chosen = art.chosen
+
+    common = ["--model", "mlp", "--dataset", "synthetic", "--epoch", "1",
+              "--bs", "16", "--no-warmup", "--lr", "0.05",
+              "--no-comm-split", "--numworkers", "8"]
+    cfg_plan = train_tpu.parse_args(
+        ["--name", "via-plan", "--plan", str(plan_path)] + common)
+    cfg_explicit = train_tpu.parse_args(
+        ["--name", "via-flags", "--graphid", str(chosen["graphid"]),
+         "--budget", str(chosen["budget"]),
+         "--randomSeed", str(chosen["seed"])] + common)
+
+    res_plan = train(cfg_plan)
+    res_explicit = train(cfg_explicit)
+    assert (schedule_fingerprint(res_plan.schedule)
+            == schedule_fingerprint(res_explicit.schedule))
+    # the planner recorded the very solver outputs training re-derived
+    assert res_plan.schedule.alpha == pytest.approx(chosen["alpha"])
+    np.testing.assert_allclose(res_plan.schedule.probs, chosen["probs"],
+                               atol=1e-9)
+    for a, b in zip(jax.tree_util.tree_leaves(res_plan.state.params),
+                    jax.tree_util.tree_leaves(res_explicit.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------- plan verify
+
+FIXTURE_RUN = os.path.join(REPO, "tests", "fixtures", "recorder_mini",
+                           "recorder-mini_mlp")
+
+
+def test_load_recorder_disagreement_fixture():
+    series = load_recorder_disagreement(FIXTURE_RUN)
+    assert series.shape == (6,)
+    assert (series > 0).all()
+    with pytest.raises(FileNotFoundError, match="disagreement"):
+        load_recorder_disagreement(os.path.join(REPO, "tests"))
+
+
+def test_verify_against_recorder_semantics():
+    # a run decaying faster than the bound is consistent
+    rho, bpe = 0.64, 4
+    bound = rho ** (bpe / 2.0)
+    decaying = 1e-2 * (0.8 * bound) ** np.arange(8)
+    rep = verify_against_recorder(rho, decaying, bpe)
+    assert rep["predicted_epoch_factor"] == pytest.approx(bound)
+    assert rep["consistent"] and rep["violations"] == 0
+    assert rep["checked_epochs"] > 0
+    # a run decaying slower than the bound is flagged where falsifiable
+    slow = 1e-2 * (min(1.2 * bound, 0.95)) ** np.arange(8)
+    rep2 = verify_against_recorder(rho, slow, bpe)
+    assert rep2["violations"] > 0 and not rep2["consistent"]
+    with pytest.raises(ValueError):
+        verify_against_recorder(0.5, np.array([1.0]), 4)
+
+
+def test_verify_plan_run_on_committed_fixture():
+    """End-to-end ``plan verify`` on the committed Recorder CSVs: the
+    fixture run (mlp, graphid 0, budget 0.5, seed 9001 — see its
+    ExpDescription) sits at the gradient-drift floor from epoch 0, so the
+    honest report is 'little is falsifiable here', not fake consistency:
+    the floor estimate must be positive and the factors must match the CSV.
+    """
+    art = sweep([{"graphid": 0}], [0.5], seed=9001, solver_iters=600)
+    report = verify_plan_run(art, FIXTURE_RUN, steps_per_epoch=4)
+    series = load_recorder_disagreement(FIXTURE_RUN)
+    np.testing.assert_allclose(report["measured_epoch_factors"],
+                               series[1:] / series[:-1], rtol=1e-12)
+    assert report["floor"] > 0
+    assert report["budget"] == 0.5
+    assert 0.0 < report["predicted_epoch_factor"] < 1.0
+    assert isinstance(report["consistent"], bool)

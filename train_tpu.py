@@ -49,6 +49,11 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--nesterov", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--matcha", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--budget", type=float, default=0.5)
+    p.add_argument("--plan", default=None,
+                   help="plan_tpu.py artifact (plan.json): pre-resolves "
+                        "graph/budget/flag-seed offline — overrides "
+                        "--graphid/--topology/--numworkers/--budget/"
+                        "--matcha/--randomSeed")
     p.add_argument("--graphid", type=int, default=0,
                    help="zoo topology id (0-5); -1 to generate --topology instead")
     p.add_argument("--topology", default="ring",
@@ -137,7 +142,7 @@ def parse_args(argv=None) -> TrainConfig:
         num_workers=args.numworkers,
         graphid=None if args.graphid < 0 else args.graphid,
         topology=args.topology, matcha=args.matcha, budget=args.budget,
-        seed=args.seed, communicator=communicator,
+        plan=args.plan, seed=args.seed, communicator=communicator,
         compress_ratio=args.ratio, compressor=args.compressor,
         consensus_lr=args.consensus_lr,
         compress_warmup_epochs=args.compress_warmup_epochs,
