@@ -14,14 +14,25 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-
 N_WORKERS = 8
+
+# XLA_FLAGS must be in the environment before the CPU backend initializes —
+# it is read lazily, so this works even when sitecustomize already imported
+# jax (same dual-path dance as tests/conftest.py)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={N_WORKERS}"
+    ).strip()
+
+import jax
 
 try:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", N_WORKERS)
 except RuntimeError:
+    pass
+except AttributeError:  # jax < 0.5 has no jax_num_cpu_devices; XLA_FLAGS applies
     pass
 
 import numpy as np

@@ -23,12 +23,19 @@ import jax
 
 __all__ = ["Communicator"]
 
-StepFn = Callable[[jax.Array, Any, jax.Array], Tuple[jax.Array, Any]]
+StepFn = Callable[..., Tuple[jax.Array, Any]]
 
 
 @dataclasses.dataclass(frozen=True)
 class Communicator:
     """A named (init, step) pair; ``step`` must be jit/scan-compatible.
+
+    ``step(flat, carry, flags_t)`` also accepts an optional fourth argument
+    ``alive: f32[N]`` — the survivor mask of the resilience layer (see
+    ``parallel.gossip`` module docstring): a dead worker's exchanges become
+    self-loops with the weight renormalized onto the survivor, so every
+    realized mixing matrix stays doubly stochastic over survivors.  Omitting
+    it (or passing ``None``) compiles the exact unmasked program.
 
     ``multi_step``, when present, runs a whole flag stream in one fused
     launch (e.g. the Pallas VMEM-resident gossip kernel) — arithmetically
@@ -49,9 +56,17 @@ class Communicator:
     multi_step: Any = None  # Optional[(flat, carry, flags[T,M]) -> (flat, carry)]
     encode_probe: Any = None  # Optional[(flat, probe_state) -> probe_state]
 
-    def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None):
+    def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None,
+            alive: Any = None):
         """Scan the communicator over a whole flag stream (consensus-only runs,
-        tests, and the gossip micro-benchmark)."""
+        tests, and the gossip micro-benchmark).
+
+        ``alive``: optional survivor mask — ``f32[N]`` (held constant for
+        the chain) or ``f32[T, N]`` (per-step, scanned alongside the flags).
+        Masked chains always take the per-step scan: ``multi_step`` fusions
+        (the Pallas W-stack kernel) precompute mixing matrices that do not
+        know about survivors, so bypassing them is a correctness requirement,
+        not a missing optimization."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -62,13 +77,33 @@ class Communicator:
         if flags.shape[0] == 0:  # empty stream: identity (a zero-size Pallas
             return flat, carry   # grid would not even initialize its output)
 
-        if self.multi_step is not None:
-            return self.multi_step(flat, carry, flags)
+        if alive is None:
+            if self.multi_step is not None:
+                return self.multi_step(flat, carry, flags)
 
-        def body(state, flags_t):
+            def body(state, flags_t):
+                x, c = state
+                x, c = self.step(x, c, flags_t)
+                return (x, c), None
+
+            (x, c), _ = lax.scan(body, (flat, carry), flags)
+            return x, c
+
+        alive = jnp.asarray(alive, jnp.float32)
+        if alive.ndim == 1:
+            def body_const(state, flags_t):
+                x, c = state
+                x, c = self.step(x, c, flags_t, alive)
+                return (x, c), None
+
+            (x, c), _ = lax.scan(body_const, (flat, carry), flags)
+            return x, c
+
+        def body_pair(state, fa):
             x, c = state
-            x, c = self.step(x, c, flags_t)
+            flags_t, alive_t = fa
+            x, c = self.step(x, c, flags_t, alive_t)
             return (x, c), None
 
-        (x, c), _ = lax.scan(body, (flat, carry), flags)
+        (x, c), _ = lax.scan(body_pair, (flat, carry), (flags, alive))
         return x, c

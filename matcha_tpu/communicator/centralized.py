@@ -11,18 +11,24 @@ from __future__ import annotations
 
 import jax
 
-from ..parallel import allreduce_mean
+from ..parallel import allreduce_mean, masked_allreduce_mean
 from .base import Communicator
 
 __all__ = ["make_centralized", "make_none"]
 
 
 def make_centralized() -> Communicator:
+    """With a survivor mask, the average runs over alive rows only and dead
+    rows are left untouched (quarantined) — the AllReduce analogue of gossip
+    self-loops, so a dead worker's stale parameters never drag the fleet."""
+
     def init(flat: jax.Array):
         return ()
 
-    def step(flat: jax.Array, carry, flags_t: jax.Array):
-        return allreduce_mean(flat), carry
+    def step(flat: jax.Array, carry, flags_t: jax.Array, alive=None):
+        if alive is None:
+            return allreduce_mean(flat), carry
+        return masked_allreduce_mean(flat, alive), carry
 
     return Communicator(name="centralized", init=init, step=step)
 
@@ -33,7 +39,7 @@ def make_none() -> Communicator:
     def init(flat: jax.Array):
         return ()
 
-    def step(flat: jax.Array, carry, flags_t: jax.Array):
+    def step(flat: jax.Array, carry, flags_t: jax.Array, alive=None):
         return flat, carry
 
     return Communicator(name="none", init=init, step=step)

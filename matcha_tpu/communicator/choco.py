@@ -12,6 +12,14 @@ the worker axis with on-device compression (no host round-trips):
 
 Persistent carry = {x̂, s} — zero-initialized like the reference's lazy init
 (communicator.py:179-182), never decayed (quirk Q4, kept deliberately).
+Both backends accept the resilience layer's survivor mask
+(``step(..., alive)``): the partner tables are thinned per step by
+``alive_i·alive_{π_j(i)}``, so a quarantined worker neither ships nor
+receives compressed messages; its local {x̂, s} cycle keeps running
+(unobservable while quarantined).  When the train step heals a worker it
+zeroes that worker's carry rows (``resilience.runtime.mask_worker_rows``,
+applied in ``train/state.py: make_train_step``) so the compression stream
+restarts from the healed parameters.
 Skipped iterations (all flags 0) leave *all* state untouched, matching the
 reference's early return (communicator.py:249-250) — implemented by scaling
 every update by an ``any_active`` mask so the compiled program stays static.
@@ -152,7 +160,7 @@ def make_choco(
 
     if backend == "batched":
 
-        def step(flat: jax.Array, carry, flags_t: jax.Array):
+        def step(flat: jax.Array, carry, flags_t: jax.Array, alive=None):
             if stochastic:
                 new_key, sub = jax.random.split(carry["key"])
             else:
@@ -163,9 +171,19 @@ def make_choco(
                 pi = perms[j]
                 return vals[pi], idx[pi]
 
+            # survivor mask: an edge exists only when both endpoints are
+            # alive, so the partner table is thinned per-step exactly like
+            # the decen edge gate (alive_i · alive_{π_j(i)}).  A dead
+            # worker neither sends nor receives; its own {x̂, s} cycle keeps
+            # running locally (harmless — quarantine makes it unobservable)
+            # and healing resets its rows (resilience.runtime).
+            partnered_eff = partnered
+            if alive is not None:
+                partnered_eff = partnered * alive[None, :] * alive[perms]
+
             flat, x_hat, s = _choco_core(
                 vals, idx, carry["x_hat"], carry["s"], flat, flags_t,
-                gather_msg=gather_msg, partnered_rows=partnered,
+                gather_msg=gather_msg, partnered_rows=partnered_eff,
                 matching_nonempty=nonempty,
                 alpha=alpha, consensus_lr=consensus_lr,
                 aligned_full=(compressor == "top_k"),
@@ -196,7 +214,8 @@ def make_choco(
     L = plan.rows_per_chip
     partnered_blocks = partnered.reshape(M, C, L)  # [M, C, L]
 
-    def chip_step(c, vals, idx, x_hat_blk, s_blk, flat_blk, flags_t):
+    def chip_step(c, vals, idx, x_hat_blk, s_blk, flat_blk, flags_t,
+                  alive=None):
         """One CHOCO step for this chip's [L, D] block, given its top-k."""
 
         def gather_msg(j):
@@ -218,6 +237,12 @@ def make_choco(
             return g_vals, g_idx
 
         partnered_rows = jnp.asarray(partnered_blocks)[:, c, :]  # [M, L]
+        if alive is not None:
+            # both-endpoints edge gate for this chip's rows: own alive ×
+            # partner alive (partner index read from the replicated mask)
+            sa = alive.reshape(C, L)[c]  # [L]
+            pa = alive[jnp.asarray(perms)].reshape(M, C, L)[:, c, :]  # [M, L]
+            partnered_rows = partnered_rows * sa[None, :] * pa
         return _choco_core(
             vals, idx, x_hat_blk, s_blk, flat_blk, flags_t,
             gather_msg=gather_msg, partnered_rows=partnered_rows,
@@ -226,13 +251,14 @@ def make_choco(
             aligned_full=(compressor == "top_k"),
         )
 
-    def body_one(flat_blk, x_hat_blk, s_blk, flags_t, key):
+    def body_one(flat_blk, x_hat_blk, s_blk, flags_t, key, alive=None):
         c = lax.axis_index(axis)
         # per-chip key: fold the chip index so every block draws its own
         # stream from the one replicated step key
         sub = jax.random.fold_in(key, c) if stochastic else None
         vals, idx = compress(flat_blk - x_hat_blk, ratio, sub)
-        return chip_step(c, vals, idx, x_hat_blk, s_blk, flat_blk, flags_t)
+        return chip_step(c, vals, idx, x_hat_blk, s_blk, flat_blk, flags_t,
+                         alive)
 
     def body_stream(flat_blk, x_hat_blk, s_blk, flags, key):
         # the key advances through the scan state exactly as the step
@@ -254,8 +280,13 @@ def make_choco(
 
     row = P(axis, None)
     sharded_one = shard_map(
-        body_one, mesh=mesh,
+        lambda f, xh, s, fl, k: body_one(f, xh, s, fl, k), mesh=mesh,
         in_specs=(row, row, row, P(), P()), out_specs=(row, row, row),
+    )
+    # masked variant: the survivor mask rides replicated, like the flags
+    sharded_one_masked = shard_map(
+        body_one, mesh=mesh,
+        in_specs=(row, row, row, P(), P(), P()), out_specs=(row, row, row),
     )
     sharded_stream = shard_map(
         body_stream, mesh=mesh,
@@ -263,13 +294,18 @@ def make_choco(
     )
     _dummy = jnp.zeros((2,), jnp.uint32)  # top_k ignores its key argument
 
-    def step(flat: jax.Array, carry, flags_t: jax.Array):
+    def step(flat: jax.Array, carry, flags_t: jax.Array, alive=None):
         if stochastic:
             new_key, sub = jax.random.split(carry["key"])
         else:
             new_key, sub = None, _dummy
-        flat, x_hat, s = sharded_one(flat, carry["x_hat"], carry["s"],
-                                     flags_t, sub)
+        if alive is None:
+            flat, x_hat, s = sharded_one(flat, carry["x_hat"], carry["s"],
+                                         flags_t, sub)
+        else:
+            flat, x_hat, s = sharded_one_masked(
+                flat, carry["x_hat"], carry["s"], flags_t, sub,
+                jnp.asarray(alive, flat.dtype))
         out = {"x_hat": x_hat, "s": s}
         if stochastic:
             out["key"] = new_key

@@ -10,6 +10,12 @@ with static permutations (gather backend for any N; explicit
 shard_map+ppermute backend riding ICI when a mesh is given).  An all-zero
 flag row yields zero weights ⇒ identity, reproducing the reference's
 skip-iteration early return (communicator.py:140-141) without a branch.
+
+Every backend accepts the resilience layer's optional survivor mask
+(``step(..., alive)``): dead workers' exchanges collapse to self-loops with
+the weight renormalized onto the survivor (see ``parallel.gossip``).  The
+fused Pallas ``multi_step`` is flag-stream-only; ``Communicator.run``
+routes masked chains through the per-step scan instead.
 """
 
 from __future__ import annotations
@@ -116,12 +122,12 @@ def make_decen(
                 f"oracle tests.",
                 stacklevel=2,
             )
-        mix: Callable = lambda x, w: gossip_mix(x, perms, w)
+        mix: Callable = lambda x, w, alive=None: gossip_mix(x, perms, w, alive)
     elif backend == "skip":
         if mesh is not None and mesh.size > 1:
             mix = shard_map_gossip_fn(perms, mesh, skip=True)
         else:
-            mix = lambda x, w: gossip_mix_skip(x, perms, w)
+            mix = lambda x, w, alive=None: gossip_mix_skip(x, perms, w, alive)
     elif backend == "dense":
         mix = dense_gossip_fn(schedule.laplacians(), compute_dtype=compute_dtype)
     elif backend == "fused":
@@ -158,8 +164,10 @@ def make_decen(
     def init(flat: jax.Array):
         return ()
 
-    def step(flat: jax.Array, carry, flags_t: jax.Array):
-        return mix(flat, alpha * flags_t), carry
+    def step(flat: jax.Array, carry, flags_t: jax.Array, alive=None):
+        if alive is None:
+            return mix(flat, alpha * flags_t), carry
+        return mix(flat, alpha * flags_t, alive), carry
 
     return Communicator(
         name=f"decen[{backend}]", init=init, step=step, multi_step=multi_step

@@ -1,6 +1,12 @@
 """Device-parallel layer: worker mesh, gossip backends, collectives."""
 
-from .collectives import allreduce_mean, broadcast_worker0, worker_disagreement
+from .collectives import (
+    allreduce_mean,
+    broadcast_worker0,
+    masked_allreduce_mean,
+    masked_mean_rows,
+    worker_disagreement,
+)
 from .gossip import (
     FoldedPlan,
     build_folded_plan,
@@ -9,6 +15,7 @@ from .gossip import (
     gossip_mix_dense,
     gossip_mix_skip,
     gossip_mix_folded,
+    masked_laplacians,
     shard_map_gossip_fn,
 )
 from .mesh import WORKER_AXIS, fold_dims, replicated, shard_workers, worker_mesh
@@ -39,6 +46,9 @@ __all__ = [
     "gossip_mix_dense",
     "gossip_mix_folded",
     "gossip_mix_skip",
+    "masked_allreduce_mean",
+    "masked_laplacians",
+    "masked_mean_rows",
     "replicated",
     "shard_map_gossip_fn",
     "shard_workers",

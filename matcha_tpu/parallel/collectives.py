@@ -12,7 +12,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["allreduce_mean", "broadcast_worker0", "worker_disagreement"]
+__all__ = ["allreduce_mean", "broadcast_worker0", "masked_mean_rows",
+           "masked_allreduce_mean", "worker_disagreement"]
 
 
 def allreduce_mean(x: jax.Array) -> jax.Array:
@@ -21,16 +22,53 @@ def allreduce_mean(x: jax.Array) -> jax.Array:
     return jnp.broadcast_to(mean, x.shape)
 
 
+def masked_mean_rows(x: jax.Array, alive: jax.Array) -> jax.Array:
+    """Mean of the rows where ``alive > 0`` — the survivors' consensus point.
+
+    ``alive: f32[N]``.  Masked rows are excluded with ``where``, not a
+    multiply: the whole point of the mask is quarantining non-finite rows,
+    and ``0·NaN = NaN`` would leak the poison straight into the mean.  With
+    no survivors at all the result is the zero vector (guarded denominator);
+    callers that heal from this mean must gate on ``alive.sum() > 0``
+    (``resilience.runtime`` does) so an all-dead step cannot silently zero
+    the model.
+    """
+    w = alive.reshape((alive.shape[0],) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    kept = jnp.where(w > 0, x, jnp.zeros_like(x))
+    return jnp.sum(w * kept, axis=0) / jnp.maximum(jnp.sum(alive), 1.0)
+
+
+def masked_allreduce_mean(x: jax.Array, alive: jax.Array) -> jax.Array:
+    """AllReduce-average over the alive rows only; dead rows keep their own
+    values (they are quarantined, not overwritten — healing is a separate,
+    explicit act in ``resilience.runtime``)."""
+    mean = masked_mean_rows(x, alive)
+    w = alive.reshape((alive.shape[0],) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return jnp.where(w > 0, jnp.broadcast_to(mean, x.shape), x)
+
+
 def broadcast_worker0(x: jax.Array) -> jax.Array:
     """Replace every worker's row with worker 0's (init-consensus alternative)."""
     return jnp.broadcast_to(x[0:1], x.shape)
 
 
-def worker_disagreement(x: jax.Array) -> jax.Array:
+def worker_disagreement(x: jax.Array, alive: jax.Array | None = None) -> jax.Array:
     """RMS distance of worker rows from consensus: ‖x − x̄‖ / √(N·D).
 
     The quantity the contraction bound ρ controls; the reference never
     measures it (SURVEY.md §5.5) — we expose it as a first-class metric.
+
+    With ``alive`` the statistic is computed over survivors only (mean and
+    RMS both restricted to alive rows): a quarantined worker's stale or
+    healed-in-progress row must not be allowed to dominate the consensus
+    metric the fault ledger and the plan verifier read.
     """
-    centered = x - jnp.mean(x, axis=0, keepdims=True)
-    return jnp.sqrt(jnp.mean(centered * centered))
+    if alive is None:
+        centered = x - jnp.mean(x, axis=0, keepdims=True)
+        return jnp.sqrt(jnp.mean(centered * centered))
+    w = alive.reshape((alive.shape[0],) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    # where, not multiply: a quarantined row may be non-finite and 0·NaN=NaN
+    centered = jnp.where(w > 0, x - masked_mean_rows(x, alive)[None],
+                         jnp.zeros_like(x))
+    denom = jnp.maximum(jnp.sum(alive), 1.0) * (x.size // x.shape[0])
+    return jnp.sqrt(jnp.sum(centered * centered) / denom)

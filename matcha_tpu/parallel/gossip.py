@@ -15,6 +15,21 @@ points = unmatched) and per-step weights ``w_j = α·flag_j``:
 which equals the reference's ``(1 − deg·α)·x_i + α·Σ_active x_partner``
 because fixed points contribute zero delta.
 
+Alive masks (runtime resilience)
+--------------------------------
+Every backend accepts an optional traced ``alive: f32[N]`` survivor mask.
+An edge of matching ``π_j`` is realized only when *both* endpoints are
+alive: its per-slot weight is scaled by ``alive_i · alive_{π_j(i)}``.  A
+dead worker's exchanges therefore become self-loops and the weight a
+survivor would have sent to its dead partner stays on the survivor's own
+row — the realized mixing matrix is ``W = I − Σ_j w_j·L_j^m`` with
+``L_j^m`` the masked (still symmetric, zero-row-sum) Laplacian, so every
+realized ``W`` remains doubly stochastic over the survivors.  This is what
+makes MATCHA's expected-mixing convergence argument survive worker loss:
+masking an edge is indistinguishable from its flag not having fired.
+``alive=None`` (the default) compiles the exact pre-resilience program —
+the hot path pays nothing for the feature it doesn't use.
+
 Backends
 --------
 ``gossip_mix``
@@ -48,6 +63,7 @@ __all__ = [
     "gossip_mix",
     "gossip_mix_skip",
     "gossip_mix_dense",
+    "masked_laplacians",
     "dense_gossip_fn",
     "FoldedPlan",
     "build_folded_plan",
@@ -69,7 +85,13 @@ def mxu_precision(compute_dtype) -> lax.Precision:
             if jnp.dtype(compute_dtype).itemsize >= 4 else lax.Precision.DEFAULT)
 
 
-def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.Array:
+def _rows(mask: jax.Array, x: jax.Array) -> jax.Array:
+    """Broadcast a per-row ``[R]`` mask over the trailing dims of ``[R, ...]``."""
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+
+
+def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array,
+               alive: jax.Array | None = None) -> jax.Array:
     """``x_i + Σ_j weights[j]·(x[π_j(i)] − x_i)`` over the leading axis.
 
     ``perms`` must be a *static* numpy ``int32[M, N]`` (part of the compiled
@@ -78,6 +100,10 @@ def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.Array
     ``[M]`` vector, typically ``alpha * flags[t]`` — masking keeps the
     communication pattern static across steps so nothing recompiles
     (SURVEY.md §7 "per-step flag-dependent communication").
+
+    ``alive``: optional traced ``f32[N]`` survivor mask — each edge's delta
+    is additionally scaled by ``alive_i·alive_{π_j(i)}`` (see module
+    docstring), keeping the realized mixing doubly stochastic over survivors.
     """
     perms = np.asarray(perms)
     if perms.ndim != 2 or perms.shape[1] != x.shape[0]:
@@ -87,11 +113,15 @@ def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.Array
         pi = perms[j]
         if np.all(pi == np.arange(pi.shape[0])):
             continue  # empty matching: zero delta regardless of flag
-        acc = acc + weights[j] * (x[pi] - x)
+        delta = x[pi] - x
+        if alive is not None:
+            delta = _rows(alive * alive[pi], delta) * delta
+        acc = acc + weights[j] * delta
     return x + acc
 
 
-def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.Array:
+def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array,
+                    alive: jax.Array | None = None) -> jax.Array:
     """``gossip_mix`` with per-matching ``lax.cond`` instead of masking:
     an inactive matching costs *nothing at runtime* (XLA compiles both
     branches but executes only the taken one), so the MATCHA budget buys
@@ -112,7 +142,11 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.
     ``select``, which executes *both* branches every step — the result stays
     correct but every skip silently becomes masked work, erasing the
     backend's entire purpose.  ``x`` must be the top-level worker-stacked
-    array; inside vmapped code use ``gossip_mix`` (masking) instead."""
+    array; inside vmapped code use ``gossip_mix`` (masking) instead.
+
+    ``alive`` masks edges *inside* the taken branch (the cond predicate
+    stays the flag weight — the skip decision is a schedule property; worker
+    death only reshapes the executed matching into survivor self-loops)."""
     perms = np.asarray(perms)
     if perms.ndim != 2 or perms.shape[1] != x.shape[0]:
         raise ValueError(f"perms {perms.shape} incompatible with x {x.shape}")
@@ -121,14 +155,16 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.
         pi = perms[j]
         if np.all(pi == np.arange(pi.shape[0])):
             continue
+
+        def exchange(o, w=weights[j], p=pi):
+            delta = x[p] - x
+            if alive is not None:
+                delta = _rows(alive * alive[p], delta) * delta
+            return o + w * delta
+
         # != 0 (not > 0) so skip stays exactly equivalent to masking for any
         # weight sign a future schedule might produce (ADVICE r2)
-        out = lax.cond(
-            weights[j] != 0,
-            lambda o, w=weights[j], p=pi: o + w * (x[p] - x),
-            lambda o: o,
-            out,
-        )
+        out = lax.cond(weights[j] != 0, exchange, lambda o: o, out)
     return out
 
 
@@ -136,11 +172,31 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array) -> jax.
 # Dense (MXU) backend
 # ---------------------------------------------------------------------------
 
+def masked_laplacians(laplacians: jax.Array, alive: jax.Array) -> jax.Array:
+    """Survivor-masked Laplacian stack: edge (u, v) kept iff both alive.
+
+    ``L_j = D_j − A_j``; masking scales the adjacency by
+    ``alive_u·alive_v`` and recomputes the degree, so each masked matrix is
+    still a Laplacian (symmetric, zero row sums) and the mixing built from
+    it stays doubly stochastic.  Works for traced ``alive`` (runtime masks)
+    and for float survival *probabilities* (the expected masked Laplacian
+    under independent worker death — what the degraded-ρ predictor uses).
+    """
+    L = jnp.asarray(laplacians)
+    n = L.shape[-1]
+    eye = jnp.eye(n, dtype=L.dtype)
+    adj = jnp.einsum("mn,nk->mnk", jnp.diagonal(L, axis1=-2, axis2=-1), eye) - L
+    adj = adj * jnp.outer(alive, alive)[None, :, :]
+    deg = jnp.sum(adj, axis=-1)
+    return jnp.einsum("mn,nk->mnk", deg, eye) - adj
+
+
 def gossip_mix_dense(
     x: jax.Array,
     laplacians: jax.Array,
     weights: jax.Array,
     compute_dtype=jnp.float32,
+    alive: jax.Array | None = None,
 ) -> jax.Array:
     """One gossip step as a single MXU matmul: ``x ← W_t @ x`` with
     ``W_t = I − Σ_j weights[j]·L_j`` built on the fly from the flag weights.
@@ -162,8 +218,14 @@ def gossip_mix_dense(
     on the CPU test mesh but ~4e-2 rel err vs the exact gather path after 20
     steps on hardware (r4 TPU gate finding) — so f32 explicitly requests
     HIGHEST to mean what it says on every backend.
+
+    ``alive`` rebuilds the Laplacian stack through :func:`masked_laplacians`
+    before forming ``W_t`` — two extra ``[M, N, N]`` elementwise passes, tiny
+    next to the ``[N, D]`` matmul.
     """
     n = x.shape[0]
+    if alive is not None:
+        laplacians = masked_laplacians(laplacians, alive)
     W = jnp.eye(n, dtype=jnp.float32) - jnp.tensordot(weights, laplacians, axes=1)
     out = jax.lax.dot(
         W.astype(compute_dtype),
@@ -175,11 +237,12 @@ def gossip_mix_dense(
 
 
 def dense_gossip_fn(laplacians: np.ndarray, compute_dtype=jnp.float32):
-    """Build ``(x, weights) -> x`` closing over the Laplacian stack."""
+    """Build ``(x, weights[, alive]) -> x`` closing over the Laplacian stack."""
     L = jnp.asarray(np.asarray(laplacians), jnp.float32)
 
-    def fn(x, weights):
-        return gossip_mix_dense(x, L, weights, compute_dtype=compute_dtype)
+    def fn(x, weights, alive=None):
+        return gossip_mix_dense(x, L, weights, compute_dtype=compute_dtype,
+                                alive=alive)
 
     return fn
 
@@ -281,17 +344,13 @@ def build_folded_plan(perms: np.ndarray, num_chips: int) -> FoldedPlan:
     return FoldedPlan(C, L, tuple(matchings))
 
 
-def _bshape(mask_row: jax.Array, x_blk: jax.Array) -> jax.Array:
-    """Broadcast a [L] mask over the trailing dims of [L, ...]."""
-    return mask_row.reshape(mask_row.shape + (1,) * (x_blk.ndim - 1))
-
-
 def gossip_mix_folded(
     x_blk: jax.Array,
     plan: FoldedPlan,
     weights: jax.Array,
     axis: str = WORKER_AXIS,
     skip: bool = False,
+    alive: jax.Array | None = None,
 ) -> jax.Array:
     """Per-chip body of the folded gossip step; call inside ``shard_map``.
 
@@ -307,14 +366,23 @@ def gossip_mix_folded(
     (see benchmarks/skip_microbench.py).  The flag predicate is replicated
     (same schedule on every chip), so all chips take the same branch and the
     collective pattern stays deadlock-free.
+
+    ``alive``: optional *replicated* ``f32[N]`` survivor mask — every chip
+    sees the whole vector (it is N floats; the state blocks are what's
+    sharded).  Each part's slots are additionally gated by
+    ``alive[own row]·alive[partner row]``; the ``ppermute`` pattern itself
+    stays static (a dead chip's block still circulates, weighted to zero),
+    which is what keeps the collective schedule deadlock-free under faults.
     """
     C = plan.num_chips
+    L = plan.rows_per_chip
     c = lax.axis_index(axis)
+    alive2d = None if alive is None else alive.reshape(C, L)
     acc = jnp.zeros_like(x_blk)
     for j, parts in enumerate(plan.matchings):
 
         def matching_delta(parts=parts):
-            gathered = jnp.zeros_like(x_blk)
+            delta = jnp.zeros_like(x_blk)
             for part in parts:
                 if part.offset == 0:
                     y = x_blk
@@ -323,9 +391,13 @@ def gossip_mix_folded(
                     y = lax.ppermute(x_blk, axis, pairs)
                 src = jnp.asarray(part.src_local)[c]  # [L]
                 m = jnp.asarray(part.mask)[c]  # [L]
-                gathered = gathered + _bshape(m, x_blk) * y[src]
-            # masks partition all L slots ⇒ `gathered` == x[π_j] here
-            return gathered - x_blk
+                if alive2d is not None:
+                    # both-endpoints gate: own row × partner row (partner
+                    # lives on chip c+offset, at its local row `src`)
+                    m = m * alive2d[c] * alive2d[(c + part.offset) % C][src]
+                # masks partition all L slots ⇒ Σ_parts m·y[src] == x[π_j]
+                delta = delta + _rows(m, x_blk) * (y[src] - x_blk)
+            return delta
 
         if skip:
             acc = acc + lax.cond(
@@ -351,9 +423,12 @@ def import_shard_map():
 
 def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS,
                         skip: bool = False):
-    """Build a jittable ``(x[N,...], weights[M]) -> x[N,...]`` gossip function
-    running as an explicit shard_map over ``mesh``.  ``skip`` forwards to
-    :func:`gossip_mix_folded` (cond-skip inactive matchings' collectives)."""
+    """Build a jittable ``(x[N,...], weights[M][, alive[N]]) -> x[N,...]``
+    gossip function running as an explicit shard_map over ``mesh``.  ``skip``
+    forwards to :func:`gossip_mix_folded` (cond-skip inactive matchings'
+    collectives).  ``alive=None`` traces the exact unmasked program; a
+    survivor mask is passed replicated (``P()``), so every chip gates its
+    edges identically."""
     from jax.sharding import PartitionSpec as P
 
     shard_map = import_shard_map()
@@ -364,8 +439,16 @@ def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS,
     def body(x_blk, weights):
         return gossip_mix_folded(x_blk, plan, weights, axis=axis, skip=skip)
 
-    def fn(x, weights):
+    def body_masked(x_blk, weights, alive):
+        return gossip_mix_folded(x_blk, plan, weights, axis=axis, skip=skip,
+                                 alive=alive)
+
+    def fn(x, weights, alive=None):
         spec = P(axis, *([None] * (x.ndim - 1)))
-        return shard_map(body, mesh=mesh, in_specs=(spec, P()), out_specs=spec)(x, weights)
+        if alive is None:
+            return shard_map(body, mesh=mesh, in_specs=(spec, P()),
+                             out_specs=spec)(x, weights)
+        return shard_map(body_masked, mesh=mesh, in_specs=(spec, P(), P()),
+                         out_specs=spec)(x, weights, alive)
 
     return fn

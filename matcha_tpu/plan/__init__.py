@@ -44,11 +44,15 @@ from .cost import (
 )
 from .spectral import (
     ConsensusSim,
+    degraded_contraction_rho,
+    degraded_solver_inputs,
     empirical_contraction_rate,
+    masked_laplacian_expectation,
     simulate_consensus,
     steps_to_consensus,
 )
 from .verify import (
+    load_fault_ledger,
     load_recorder_disagreement,
     verify_against_recorder,
     verify_plan_run,
@@ -60,8 +64,12 @@ __all__ = [
     "PlanArtifact",
     "apply_plan",
     "calibrate_cost_model",
+    "degraded_contraction_rho",
+    "degraded_solver_inputs",
     "empirical_contraction_rate",
     "expected_comm_units",
+    "masked_laplacian_expectation",
+    "load_fault_ledger",
     "load_measured_comm_times",
     "load_plan",
     "load_recorder_disagreement",

@@ -42,6 +42,9 @@ __all__ = [
     "simulate_consensus",
     "empirical_contraction_rate",
     "steps_to_consensus",
+    "masked_laplacian_expectation",
+    "degraded_contraction_rho",
+    "degraded_solver_inputs",
 ]
 
 
@@ -150,6 +153,95 @@ def empirical_contraction_rate(log_errors: np.ndarray) -> float:
         raise ValueError("need at least one simulated step")
     per_trial = (log_errors[:, -1] - log_errors[:, 0]) / T
     return float(np.exp(per_trial.mean()))
+
+
+def masked_laplacian_expectation(
+    laplacians: np.ndarray, worker_alive: np.ndarray
+) -> np.ndarray:
+    """E[L_j] under independent worker availability ``worker_alive: f64[N]``.
+
+    An edge (u, v) of matching j is realized only when both endpoints are
+    up, so its expected contribution scales by ``a_u·a_v``; degrees are
+    recomputed from the thinned adjacency, keeping each expected matrix a
+    genuine Laplacian (symmetric, zero row sums).  This is the numpy twin of
+    the traced ``parallel.gossip.masked_laplacians`` — the predictor and the
+    executor share one masking rule by construction.
+    """
+    L = np.asarray(laplacians, np.float64)
+    a = np.asarray(worker_alive, np.float64)
+    n = L.shape[-1]
+    eye = np.eye(n)
+    adj = np.einsum("mn,nk->mnk", np.diagonal(L, axis1=-2, axis2=-1), eye) - L
+    adj = adj * np.outer(a, a)[None, :, :]
+    deg = adj.sum(axis=-1)
+    return np.einsum("mn,nk->mnk", deg, eye) - adj
+
+
+def degraded_solver_inputs(
+    laplacians: np.ndarray,
+    probs: np.ndarray,
+    worker_alive: Optional[np.ndarray] = None,
+    link_up: Optional[np.ndarray] = None,
+):
+    """``(masked Laplacian stack, effective probs)`` for the degraded fleet.
+
+    Workers with availability exactly 0 are *projected out* (principal
+    submatrix over survivors): a permanently dead worker never rejoins the
+    mean, so any full-space consensus measure is pinned at 1 regardless of
+    α — useless as a bound on what masked gossip actually contracts (the
+    survivors' disagreement, which is also what the runtime metric and the
+    Recorder report) and degenerate as a solver objective.  Partially-alive
+    workers (revivals, stragglers) stay in, edge-scaled by their alive
+    fractions.  The masked stack restricted to survivors is exact: fully
+    dead workers contribute no edge weight anywhere.
+    """
+    Ls = np.asarray(laplacians, np.float64)
+    p = np.asarray(probs, np.float64)
+    if worker_alive is not None:
+        a = np.broadcast_to(np.asarray(worker_alive, np.float64),
+                            (Ls.shape[-1],))
+        Ls = masked_laplacian_expectation(Ls, a)
+        keep = a > 0
+        if not keep.all():
+            Ls = Ls[:, keep][:, :, keep]
+    if link_up is not None:
+        p = p * np.broadcast_to(np.asarray(link_up, np.float64), p.shape)
+    return Ls, p
+
+
+def degraded_contraction_rho(
+    laplacians: np.ndarray,
+    probs: np.ndarray,
+    alpha: float,
+    worker_alive: Optional[np.ndarray] = None,
+    link_up: Optional[np.ndarray] = None,
+) -> float:
+    """Closed-form ρ of the *degraded* expected mixing.
+
+    ``worker_alive``: per-worker availability (scalar broadcastable or
+    f64[N]) — the alive-mask expectation of a runtime fault plan
+    (``RuntimeFaults.expected_alive``).  ``link_up``: per-matching survival
+    fraction (scalar or f64[M]) — ``1 − drop_prob`` for i.i.d. link drops,
+    or ``RuntimeFaults.expected_link_up``.  Either omitted means "no
+    degradation of that kind"; with both omitted this is exactly
+    ``contraction_rho``.
+
+    This is what keeps ``plan verify`` honest on faulty runs: the bound the
+    measured disagreement is compared against must be the bound for the
+    schedule *as degraded*, not the fault-free fiction.  Permanently-dead
+    workers are projected out (see :func:`degraded_solver_inputs`): the
+    bound is on *survivor* consensus, the quantity masked gossip contracts
+    and the masked disagreement metric measures.  Like the base bound, it
+    treats the masked Laplacians as deterministic per-matching matrices
+    with Bernoulli flags (the alive-mask's own variance is not modeled) —
+    a bound on the expectation; its consistency (no degradation ⇒ base
+    bound) and monotonicity (deaths/drops only slow contraction) are
+    pinned in ``tests/test_resilience.py``.
+    """
+    Ls, p = degraded_solver_inputs(laplacians, probs, worker_alive, link_up)
+    if Ls.shape[-1] < 2:
+        return 1.0  # zero or one survivor: no consensus process to bound
+    return float(contraction_rho(Ls, p, float(alpha)))
 
 
 def steps_to_consensus(rho: float, target: float = 1e-3) -> float:

@@ -18,13 +18,53 @@ limit; see docs/DESIGN.md §10).
 from __future__ import annotations
 
 import glob
+import json
 import os
 from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["load_recorder_disagreement", "verify_against_recorder",
-           "verify_plan_run"]
+__all__ = ["load_fault_ledger", "load_recorder_disagreement",
+           "verify_against_recorder", "verify_plan_run"]
+
+
+def load_fault_ledger(run_dir: str) -> Optional[Dict]:
+    """Read the Recorder's ``faults.json`` ledger, if the run wrote one.
+
+    Returns a ``plan``-entry degradation summary
+    (``expected_alive``/``expected_link_up``) when present — what the
+    degraded-ρ correction needs — else None.  A resumed run that changed
+    its fault plan carries *several* plan entries; they are merged by
+    elementwise **minimum** (the most-degraded declaration wins), because
+    the correction's job is to avoid phantom violations — the bound must be
+    no tighter than any regime the run actually trained under.  Entries
+    whose array shapes disagree fall back to the last (most recent) entry.
+    """
+    path = os.path.join(run_dir, "faults.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        ledger = json.load(f)
+    plans = [e for e in ledger.get("events", []) if e.get("kind") == "plan"]
+    if not plans:
+        return None
+    if len(plans) == 1:
+        return plans[0]
+    merged = dict(plans[-1])
+    try:
+        merged["expected_alive"] = np.min(
+            [p["expected_alive"] for p in plans], axis=0).tolist()
+        merged["expected_link_up"] = np.min(
+            [p["expected_link_up"] for p in plans], axis=0).tolist()
+        merged["name"] = "+".join(dict.fromkeys(
+            str(p.get("name", "faultplan")) for p in plans))
+        # provenance must match the merged numbers: attribute them to the
+        # union of declared events, not just the last plan's list
+        merged["events"] = [e for p in plans for e in p.get("events", [])]
+        merged.pop("recordtime", None)  # no single timestamp is honest
+    except (KeyError, ValueError):
+        return plans[-1]
+    return merged
 
 
 def load_recorder_disagreement(run_dir: str, rank: int = 0) -> np.ndarray:
@@ -112,11 +152,42 @@ def verify_plan_run(
     """End-to-end ``plan verify``: artifact + Recorder dir → report.
 
     ``rho`` overrides the artifact's recorded value (e.g. to check a
-    re-solved schedule); by default the chosen candidate's ρ is used.
+    re-solved schedule); by default the chosen candidate's ρ is used —
+    **degraded** by the run's fault ledger when the Recorder wrote one
+    (``faults.json``, the runtime fault plan's alive/link expectations).
+    Scoring a faulty run against the fault-free ρ would report phantom
+    violations for a run that contracted exactly as fast as its degraded
+    mixing allows; the correction is what keeps ``plan verify`` honest under
+    chaos (the fault-free bound is still reported as ``rho_fault_free``).
     """
     series = load_recorder_disagreement(run_dir, rank=rank)
     use_rho = float(artifact.chosen["rho"] if rho is None else rho)
+    fault_note = None
+    ledger = load_fault_ledger(run_dir) if rho is None else None
+    if ledger is not None:
+        from .autotune import resolve_topology
+        from .spectral import degraded_contraction_rho
+        from ..topology import matching_laplacians
+
+        chosen = artifact.chosen
+        decomposed, size, _ = resolve_topology(chosen, int(chosen["seed"]))
+        degraded = degraded_contraction_rho(
+            matching_laplacians(decomposed, size),
+            np.asarray(chosen["probs"], np.float64),
+            float(chosen["alpha"]),
+            worker_alive=np.asarray(ledger["expected_alive"], np.float64),
+            link_up=np.asarray(ledger["expected_link_up"], np.float64),
+        )
+        fault_note = {
+            "fault_plan": ledger.get("name", "faultplan"),
+            "rho_fault_free": use_rho,
+            "expected_alive_mean": float(np.mean(ledger["expected_alive"])),
+            "expected_link_up_mean": float(np.mean(ledger["expected_link_up"])),
+        }
+        use_rho = float(degraded)
     report = verify_against_recorder(use_rho, series, steps_per_epoch)
     report["run_dir"] = run_dir
     report["budget"] = artifact.chosen["budget"]
+    if fault_note is not None:
+        report["faults"] = fault_note
     return report

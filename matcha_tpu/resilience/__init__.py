@@ -1,0 +1,51 @@
+"""Runtime resilience: survive worker and link failures instead of aborting.
+
+Three pillars (DESIGN.md §8):
+
+* **Self-healing gossip** — an alive mask threaded through every gossip
+  backend turns a dead worker's exchanges into self-loops with renormalized
+  weights (realized mixing stays doubly stochastic over survivors), and a
+  quarantined worker is healed from the masked gossip average of its alive
+  peers (``runtime``).
+* **Declarative fault plans** — dead workers, stragglers, NaN emitters, and
+  link outages over step ranges, compiled into static arrays for
+  deterministic chaos testing (``faultplan``).
+* **Rollback recovery** — ``train/loop.py`` uses these pieces to roll back
+  to the last good state on divergence, back off the LR, and re-derive α
+  for the degraded link reliability (``resolve_degraded_alpha``) instead of
+  raising on the first non-finite epoch.
+"""
+
+from .faultplan import (
+    FAULT_KINDS,
+    FaultEvent,
+    FaultPlan,
+    RuntimeFaults,
+    load_fault_plan,
+    resolve_degraded_alpha,
+)
+from .runtime import (
+    finite_rows,
+    gossip_quarantined,
+    heal_and_mask,
+    heal_worker_stat_rows,
+    inject_nan_rows,
+    mask_worker_rows,
+    state_finite_rows,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultEvent",
+    "FaultPlan",
+    "RuntimeFaults",
+    "finite_rows",
+    "gossip_quarantined",
+    "heal_and_mask",
+    "heal_worker_stat_rows",
+    "inject_nan_rows",
+    "load_fault_plan",
+    "mask_worker_rows",
+    "resolve_degraded_alpha",
+    "state_finite_rows",
+]

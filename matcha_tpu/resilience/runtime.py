@@ -1,0 +1,182 @@
+"""Self-healing primitives executed inside the compiled train step.
+
+The SPMD program cannot branch per worker, so resilience is arithmetic:
+non-finite rows are *detected* with a per-row reduction, *quarantined* by
+zeroing their edges in the gossip mask (the masked mixing stays doubly
+stochastic over survivors — ``parallel.gossip``), and *healed* by
+overwriting them with the survivors' average.  All of it is masked
+elementwise work on the ``[N, D]`` stack; the communication pattern never
+changes, so nothing recompiles and nothing can deadlock.
+
+Healing is deliberately conservative: a row is only overwritten when there
+is at least one alive-and-finite survivor *and* the survivor mean itself is
+finite.  An all-dead step therefore leaves the state untouched (the
+epoch-level rollback in ``train/loop.py`` owns global divergence) instead of
+silently zeroing the model — the failure mode a naive ``sum/max(count, 1)``
+heal would produce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel import masked_mean_rows
+
+__all__ = ["finite_rows", "inject_nan_rows", "heal_and_mask",
+           "gossip_quarantined", "heal_worker_stat_rows", "mask_worker_rows",
+           "state_finite_rows"]
+
+
+def finite_rows(flat: jax.Array) -> jax.Array:
+    """f32[N] — 1.0 where the row is entirely finite."""
+    return jnp.all(jnp.isfinite(flat), axis=tuple(range(1, flat.ndim))) \
+              .astype(jnp.float32)
+
+
+def inject_nan_rows(flat: jax.Array, inject: jax.Array) -> jax.Array:
+    """Poison the rows where ``inject > 0`` (the ``nan`` fault event)."""
+    mask = inject.reshape((inject.shape[0],) + (1,) * (flat.ndim - 1))
+    return jnp.where(mask > 0, jnp.nan, flat)
+
+
+def heal_and_mask(
+    flat: jax.Array, alive_t: jax.Array, revive_t: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Quarantine, heal, and return the effective survivor mask.
+
+    Returns ``(flat, ok, healed, finite)``:
+
+    * ``ok``     f32[N] — rows that participate in gossip this step: planned
+      alive ∧ finite (after healing).
+    * ``healed`` f32[N] — rows overwritten with the survivors' mean: planned
+      revivals plus alive-but-non-finite rows (a NaN emitter's row the
+      instant it is detected, *before* it can gossip the poison anywhere).
+    * ``finite`` f32[N] — post-heal row finiteness, derived algebraically
+      (``finite_before ∨ healed``; healing cannot un-finite other rows) so
+      the caller can seal the gossip input (:func:`gossip_quarantined`)
+      without a second full isfinite pass over the state.
+    """
+    finite = finite_rows(flat)
+    ok = alive_t * finite
+    want_heal = jnp.clip(revive_t + alive_t * (1.0 - finite), 0.0, 1.0)
+    # the heal target is the average of the alive *peers* — a revived
+    # worker's own stale-but-finite row must not vote on where it rejoins
+    # (with a small fleet its stale value would drag the target far from
+    # the survivors' consensus)
+    donors = ok * (1.0 - want_heal)
+    mean = masked_mean_rows(flat, donors)
+    # heal only from a real, finite quorum — an all-dead step must not
+    # "heal" everyone to the guarded-denominator zero vector
+    can_heal = (jnp.sum(donors) > 0) & jnp.all(jnp.isfinite(mean))
+    healed = want_heal * can_heal.astype(jnp.float32)
+    hmask = healed.reshape((healed.shape[0],) + (1,) * (flat.ndim - 1))
+    # where, not lerp: the row being healed is typically non-finite and a
+    # multiplicative blend would re-introduce the NaN as 0·NaN
+    flat = jnp.where(hmask > 0, jnp.broadcast_to(mean, flat.shape), flat)
+    # healed rows are finite by construction; a failed heal (no quorum)
+    # keeps the poisoned row quarantined
+    finite = jnp.clip(finite + healed, 0.0, 1.0)
+    ok = alive_t * finite
+    return flat, ok, healed, finite
+
+
+def gossip_quarantined(step_fn, flat: jax.Array, carry: Any,
+                       flags_t: jax.Array, ok: jax.Array,
+                       gate: jax.Array | None = None):
+    """Run one communicator step with non-finite rows *arithmetically* sealed.
+
+    Edge masking alone is not enough to quarantine a poisoned row: the
+    masked weight is zero but ``0·NaN = NaN``, so a NaN row would still leak
+    through the dense backend's matmul (every receiver reads the zeroed
+    column) and the gather backends' masked deltas.  The seal substitutes
+    zeros for the non-finite rows on the *input* (their edges are already
+    weight-zero via ``ok``, so the zeros contribute nothing), then restores
+    the original rows on the output — the poison stays visible to the
+    epoch-level divergence detector instead of being laundered into zeros.
+
+    ``gate``: the per-row finite mask of ``flat`` if the caller already has
+    it (:func:`heal_and_mask` returns it) — skips a redundant full isfinite
+    pass over the state.
+    """
+    if gate is None:
+        gate = finite_rows(flat)
+    g = gate.reshape((gate.shape[0],) + (1,) * (flat.ndim - 1))
+    safe = jnp.where(g > 0, flat, jnp.zeros_like(flat))
+    mixed, carry = step_fn(safe, carry, flags_t, ok)
+    return jnp.where(g > 0, mixed, flat), carry
+
+
+def mask_worker_rows(tree: Any, keep: jax.Array, num_workers: int) -> Any:
+    """Zero the worker rows where ``keep == 0`` in every ``[N, ...]`` float
+    leaf.
+
+    Used to reset a healed worker's optimizer momentum and CHOCO carry rows
+    (``keep = 1 − healed``): a revived replica restarts from the survivors'
+    parameter average with clean algorithm state, instead of replaying the
+    stale momentum it accumulated while quarantined.  The zeroing is a
+    ``where``, not a multiply — the row being reset may hold the very NaN
+    (an organically overflowed momentum) that triggered the heal, and
+    ``0·NaN = NaN`` would let it survive its own reset.  Non-float leaves
+    and leaves without a worker-major axis (step counters, PRNG keys) pass
+    through untouched.
+    """
+    def one(x):
+        if (hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == num_workers
+                and jnp.issubdtype(x.dtype, jnp.inexact)):
+            k = keep.reshape((num_workers,) + (1,) * (x.ndim - 1))
+            return jnp.where(k > 0, x, jnp.zeros_like(x))
+        return x
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def heal_worker_stat_rows(tree: Any, healed: jax.Array, donors: jax.Array,
+                          num_workers: int) -> Any:
+    """Overwrite healed workers' rows of per-worker *statistic* leaves with
+    the donors' average.
+
+    BatchNorm running statistics are the one piece of per-worker state that
+    can neither be kept through a heal (a quarantined worker's NaN
+    activations poison them, and a finite-but-stale mean/var misnormalizes
+    the healed parameters) nor zero-reset like momentum (variance 0 is not
+    a neutral value).  A revived worker therefore adopts the fleet's
+    normalization statistics along with its parameters.  ``donors`` is the
+    alive-and-not-being-healed row mask; with no donors the leaf passes
+    through unchanged (the matching params heal was refused too).  All
+    masking is ``where``-based — the healed row may be non-finite.
+    """
+    def one(x):
+        if not (hasattr(x, "ndim") and x.ndim >= 1
+                and x.shape[0] == num_workers
+                and jnp.issubdtype(x.dtype, jnp.inexact)):
+            return x
+        mean = masked_mean_rows(x, donors.astype(x.dtype))
+        h = healed.reshape((num_workers,) + (1,) * (x.ndim - 1))
+        return jnp.where(h > 0, jnp.broadcast_to(mean, x.shape), x)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def state_finite_rows(state: Any, num_workers: int) -> jax.Array:
+    """bool[N] — per-worker all-finite over the *entire* train state.
+
+    Walks every inexact leaf: worker-major ``[N, ...]`` leaves reduce over
+    their trailing axes; global leaves AND into every worker.  This is the
+    detector behind the full-TrainState divergence check — an Inf that lives
+    only in optimizer momentum (params still finite this epoch) is caught
+    here, one epoch before it would have poisoned the parameters.
+    """
+    mask = jnp.ones((num_workers,), bool)
+    for leaf in jax.tree_util.tree_leaves(state):
+        if not (hasattr(leaf, "dtype")
+                and jnp.issubdtype(leaf.dtype, jnp.inexact)):
+            continue
+        if leaf.ndim >= 1 and leaf.shape[0] == num_workers:
+            mask = mask & jnp.all(jnp.isfinite(leaf),
+                                  axis=tuple(range(1, leaf.ndim)))
+        else:
+            mask = mask & jnp.all(jnp.isfinite(leaf))
+    return mask

@@ -37,6 +37,16 @@ def with_link_failures(
     Each (step, matching) flag that is 1 survives with probability
     ``1 − drop_prob``.  Deterministic under ``seed``; the original schedule
     is unchanged (schedules are frozen).
+
+    The returned schedule's ``probs`` are the *effective* activation
+    probabilities ``p_j·(1−drop_prob)`` — the thinned flag stream really is
+    a Bernoulli draw at those rates, and every ``probs`` consumer
+    (``expected_rho``, the plan/spectral scorers, ``extend``) must see the
+    mixing that will actually run, not the undegraded fiction.  ``alpha`` is
+    deliberately left at the original solve (schedules are frozen contracts);
+    re-deriving it for the degraded rates is the runtime recovery path's job
+    (``resilience.resolve_degraded_alpha``) or an explicit
+    ``solve_mixing_weight(laplacians, schedule.probs)`` by the caller.
     """
     if not 0.0 <= drop_prob <= 1.0:
         raise ValueError(f"drop_prob must be in [0,1], got {drop_prob}")
@@ -44,7 +54,9 @@ def with_link_failures(
     survives = rng.random(schedule.flags.shape) >= drop_prob
     flags = (schedule.flags.astype(bool) & survives).astype(np.uint8)
     return dataclasses.replace(
-        schedule, flags=flags, name=f"{schedule.name}+drop{drop_prob}"
+        schedule, flags=flags,
+        probs=np.asarray(schedule.probs, np.float64) * (1.0 - drop_prob),
+        name=f"{schedule.name}+drop{drop_prob}",
     )
 
 
@@ -53,5 +65,8 @@ def effective_activation_probs(schedule: Schedule, drop_prob: float) -> np.ndarr
 
     Feed this back into ``solve_mixing_weight`` to re-derive an α that is
     optimal for the degraded link reliability (the reference cannot do this —
-    its α is frozen at construction, graph_manager.py:268-296)."""
+    its α is frozen at construction, graph_manager.py:268-296).  Note a
+    schedule returned by :func:`with_link_failures` already *stores* its
+    degraded rates in ``probs``; applying this on top models a second,
+    independent drop process (the probabilities multiply)."""
     return np.asarray(schedule.probs) * (1.0 - drop_prob)

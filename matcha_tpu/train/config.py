@@ -86,6 +86,20 @@ class TrainConfig:
     # vmapped (workers × batch) forward stays within HBM for big models
     eval_batch: int = 0
 
+    # resilience (DESIGN.md §8): runtime fault injection + rollback recovery
+    # fault plan: a resilience.FaultPlan, a parsed dict, or a path to its
+    # JSON (train_tpu.py --fault-plan) — compiled into static per-step
+    # alive/nan/link arrays injected into the SPMD step for deterministic
+    # chaos testing; None disables all fault machinery (the exact
+    # pre-resilience program compiles)
+    fault_plan: Optional[object] = None
+    # rollback recovery: on a non-finite epoch, restore the last good state,
+    # scale the LR by recovery_lr_backoff, re-derive alpha for the degraded
+    # link reliability, and retry — up to this many times before raising
+    # TrainingDiverged.  0 keeps the historical raise-immediately behavior.
+    max_recoveries: int = 0
+    recovery_lr_backoff: float = 0.5
+
     # execution
     # memory/FLOPs trades for many-workers-per-chip folding (both exact):
     remat: bool = False  # block-level activation rematerialization
@@ -133,3 +147,18 @@ class TrainConfig:
             raise ValueError(
                 "compress_warmup_epochs only applies to the choco "
                 "communicator (the only compressed one)")
+        if self.max_recoveries < 0:
+            raise ValueError("max_recoveries must be >= 0")
+        if self.max_recoveries and not self.halt_on_divergence:
+            raise ValueError(
+                "max_recoveries needs halt_on_divergence=True — recovery is "
+                "what the detector triggers; with detection off there is "
+                "nothing to roll back from")
+        if not 0.0 < self.recovery_lr_backoff <= 1.0:
+            raise ValueError(
+                f"recovery_lr_backoff must be in (0, 1], got "
+                f"{self.recovery_lr_backoff}")
+        if self.fault_plan is not None and self.communicator == "none":
+            raise ValueError(
+                "fault_plan needs a communicator: without gossip there are "
+                "no links to fail and no peers to heal a worker from")

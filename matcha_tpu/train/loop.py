@@ -18,6 +18,7 @@ initial replicas, then runs the epoch loop.  Differences by design:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 from typing import Dict, List, Optional
@@ -39,6 +40,7 @@ from ..data import (
 )
 from ..models import dataset_input_shape, select_model
 from ..parallel import shard_workers, worker_mesh
+from ..resilience.runtime import state_finite_rows
 from ..schedule import Schedule, fixed_schedule, matcha_schedule
 from ..topology import decompose, graph_size, make_graph, select_graph
 from .checkpoint import restore_checkpoint, save_checkpoint
@@ -52,14 +54,21 @@ __all__ = ["build_schedule", "build_dataset", "train", "TrainResult",
 
 
 class TrainingDiverged(RuntimeError):
-    """Raised when an epoch produces a non-finite loss or parameters.
+    """Raised when an epoch produces a non-finite loss or train state.
 
     The reference has no failure detection at all (SURVEY.md §5.3) — a NaN
     would silently propagate through gossip to every replica and surface as
     garbage accuracy many epochs later.  Detecting it at the epoch boundary
-    costs two reductions and names the epoch it happened in; the recorder is
-    flushed first so the loss curve leading into the blow-up survives on
-    disk."""
+    costs a handful of reductions and names the epoch it happened in; the
+    recorder is flushed first so the loss curve leading into the blow-up
+    survives on disk.  The check covers the *whole* ``TrainState`` — an Inf
+    living only in optimizer momentum is caught the epoch it appears, not an
+    epoch later when it has already poisoned the parameters.
+
+    With ``TrainConfig.max_recoveries > 0`` this exception is a last resort:
+    the loop first rolls back to the last good state, backs the LR off, and
+    re-derives α for the degraded link reliability (DESIGN.md §8) — it
+    raises only after the retry budget is exhausted."""
 
 
 def build_schedule(config: TrainConfig, iterations: int) -> Schedule:
@@ -136,6 +145,30 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     total_steps = config.epochs * bpe
 
     schedule = build_schedule(config, total_steps + 1)
+
+    # runtime fault plan (DESIGN.md §8): compiled against this schedule's
+    # horizon into static alive/nan/link arrays, exactly like the flags.
+    # Link outages fold into the flag stream right here — a severed link is
+    # indistinguishable from its flag not firing, so the communicators need
+    # no extra machinery for it (and it composes with any offline
+    # `with_link_failures` thinning already baked into schedule.flags).
+    faults = fault_plan = None
+    if config.fault_plan is not None:
+        from ..resilience import load_fault_plan
+
+        fault_plan = load_fault_plan(config.fault_plan)
+        faults = fault_plan.compile(schedule.iterations, config.num_workers,
+                                    schedule.num_matchings)
+    run_flags = (np.asarray(schedule.flags, np.float32) * faults.link_up
+                 if faults is not None else schedule.flags)
+    # checkpoints always fingerprint the *as-built* schedule: recovery may
+    # re-derive α (rebinding `schedule`), but no config could reproduce that
+    # α at resume time — fingerprinting it would leave every post-recovery
+    # checkpoint permanently unresumable.  A resumed run restarts at the
+    # originally-solved α and re-derives again if faults recur; the flag
+    # stream (what the cursor's meaning depends on) is identical either way.
+    schedule0 = schedule
+
     mesh = None
     if config.devices is None or config.devices > 1:
         try:
@@ -158,11 +191,20 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
     model = select_model(config.model, config.dataset,
                          num_classes=dataset.num_classes, remat=config.remat)
-    lr_schedule = make_lr_schedule(
-        config.lr, bpe, base_lr=config.base_lr, warmup=config.warmup,
-        warmup_epochs=config.warmup_epochs, decay_epochs=config.decay_epochs,
-        decay_factor=config.decay_factor,
-    )
+
+    # lr_scale is the recovery backoff (1.0 until a rollback); everything
+    # LR-derived is built through here so retries rebuild consistently
+    lr_scale = 1.0
+
+    def _make_lr():
+        return make_lr_schedule(
+            config.lr * lr_scale, bpe, base_lr=config.base_lr * lr_scale,
+            warmup=config.warmup, warmup_epochs=config.warmup_epochs,
+            decay_epochs=config.decay_epochs,
+            decay_factor=config.decay_factor,
+        )
+
+    lr_schedule = _make_lr()
     optimizer = make_optimizer(lr_schedule, config.momentum,
                                config.weight_decay, config.nesterov)
 
@@ -175,13 +217,41 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         state = shard_workers(state, mesh)
 
     def _make_step(comm):
+        # reads `optimizer`, `lr_schedule`, and `faults` at call time: the
+        # recovery path rebinds them (LR backoff, consumed NaN events) and
+        # rebuilds, so retried epochs compile against the updated program
         return make_train_step(
-            model, optimizer, comm, flattener, schedule.flags,
+            model, optimizer, comm, flattener, run_flags,
             dropout=False, lr_schedule=lr_schedule,
-            grad_chunk=config.grad_chunk,
+            grad_chunk=config.grad_chunk, faults=faults,
         )
 
-    step_fn = _make_step(communicator)
+    step_fn = None  # populated by _build_programs() below
+
+    def _build_programs():
+        """(Re)build every compiled program from the current locals —
+        ``lr_scale``, ``schedule`` (possibly α-rederived), ``faults``
+        (possibly with consumed NaN events).  One recipe for setup and for
+        recovery retries, so the two can never drift apart.
+
+        On comm_timer: the two-program comp/comm split (SURVEY.md §5.1)
+        re-runs the epoch's gossip chain in isolation and charges its
+        wall-clock to comm_time — XLA fuses gossip into the train step, so
+        the reference's timer-around-sendrecv cannot bracket it.  Costs one
+        extra gossip chain per epoch; measure_comm_split=False disables."""
+        nonlocal lr_schedule, optimizer, communicator, step_fn, scan_step, \
+            comm_timer
+        lr_schedule = _make_lr()
+        optimizer = make_optimizer(lr_schedule, config.momentum,
+                                   config.weight_decay, config.nesterov)
+        communicator = _make_comm(config.compress_ratio)
+        step_fn = _make_step(communicator)
+        scan_step = _make_epoch_scan(step_fn) if config.scan_epoch else None
+        comm_timer = (
+            _make_comm_timer(communicator, flattener)
+            if config.measure_comm_split and config.communicator != "none"
+            else None)
+        _stages.clear()
 
     # CHOCO compression warmup: epochs < compress_warmup_epochs run at a
     # linearly ramped drop-ratio (0 at epoch 0 — dense-rate consensus while
@@ -225,22 +295,47 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
     evaluate = make_eval_fn(model)
     recorder = Recorder(config, config.num_workers)
+    if start_epoch and config.save:
+        # re-align the CSV series with the restored epoch: reload the
+        # previous run's rows truncated to the checkpoint, so save() extends
+        # the history instead of overwriting it (or double-appending the
+        # replayed epochs on resume from an older checkpoint)
+        recorder.load_previous(start_epoch)
+    if fault_plan is not None:
+        plan_events = fault_plan.to_json()["events"]
+        already = any(e.get("kind") == "plan" and e.get("events") == plan_events
+                      for e in recorder.faults)
+        if not already:  # resume reloaded the ledger: don't duplicate it
+            recorder.log_fault(
+                "plan", name=fault_plan.name, events=plan_events,
+                expected_alive=[float(v) for v in faults.expected_alive()],
+                expected_link_up=[float(v) for v in faults.expected_link_up()],
+            )
     rng = jax.random.PRNGKey(config.seed)
     history: List[Dict] = []
 
-    scan_step = _make_epoch_scan(step_fn) if config.scan_epoch else None
+    scan_step = comm_timer = None
+    _build_programs()
 
-    # comp/comm split (SURVEY.md §5.1): XLA fuses gossip into the train step,
-    # so the reference's timer-around-sendrecv (train_mpi.py:138-143) cannot
-    # bracket it.  Two-program split instead: re-run the epoch's gossip chain
-    # in isolation on the current flat parameter stack and charge its
-    # wall-clock to comm_time.  Costs one extra gossip chain per epoch
-    # (a few % of the epoch); disable with measure_comm_split=False.
-    comm_timer = None
-    if config.measure_comm_split and config.communicator != "none":
-        comm_timer = _make_comm_timer(communicator, flattener)
+    # rollback-recovery bookkeeping (DESIGN.md §8).  The snapshot must be a
+    # real device-side copy: the scanned epoch *donates* the state buffers,
+    # so a held reference alone would be invalidated by the very epoch it is
+    # supposed to guard against.
+    recoveries_used = 0
+    alpha_rederived = False
+    emergency_written = False
+    snapshot = None
+    finite_check = jax.jit(
+        lambda s: state_finite_rows(s, config.num_workers))
 
-    for epoch in range(start_epoch, config.epochs):
+    epoch = start_epoch
+    while epoch < config.epochs:
+        if recoveries_used < config.max_recoveries:
+            # budget exhausted ⇒ stop paying the copy (it could never be
+            # used); the stale snapshot must not linger in HBM either
+            snapshot = jax.tree_util.tree_map(jnp.copy, state)
+        else:
+            snapshot = None
         e_step, e_scan, e_timer = step_fn, scan_step, comm_timer
         stage = _stage_fns(epoch)
         if stage is not None:  # compression-warmup epoch: ramped-ratio programs
@@ -263,10 +358,78 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
         if config.halt_on_divergence:
             loss_bad = not np.isfinite(epoch_metrics["loss"])
-            params_bad = not loss_bad and not bool(_all_finite(state.params))
+            # full-TrainState detector (params + BN stats + momentum + comm
+            # carry): an Inf that so far lives only in momentum is caught
+            # now, not an epoch later when it reaches the parameters.
+            # Only workers currently quarantined by a *dead* event are
+            # exempt — they are guaranteed a heal (params) + row reset
+            # (momentum/carry) at revival.  Stragglers are never healed, so
+            # their state must stay finite like anyone else's.
+            finite_rows = np.asarray(finite_check(state))
+            if faults is not None:
+                cursor = max(min(int(state.step) - 1,
+                                 faults.iterations - 1), 0)
+                relevant = faults.dead_alive[cursor] > 0
+            else:
+                relevant = np.ones_like(finite_rows)
+            params_bad = bool(np.any(~finite_rows & relevant))
             if loss_bad or params_bad:
                 what = ("training loss " + str(epoch_metrics["loss"])) if loss_bad \
-                    else "parameters"
+                    else "train state (params/BN stats/momentum/comm carry)"
+                if recoveries_used < config.max_recoveries and snapshot is not None:
+                    # ---- recover instead of abort (DESIGN.md §8) --------
+                    recoveries_used += 1
+                    if config.save and not emergency_written and epoch > 0:
+                        # last-good state, resumable with --resume
+                        path = f"{config.savePath}/{config.name}_emergency"
+                        save_checkpoint(path, snapshot, epoch - 1,
+                                        schedule=schedule0)
+                        emergency_written = True
+                        recorder.log_fault("emergency_checkpoint",
+                                           epoch=epoch, path=path)
+                    if faults is not None:
+                        # the chaos already happened: replaying the rolled-
+                        # back window must not re-fire its NaN injections
+                        lo = epoch * bpe
+                        hi = min((epoch + 1) * bpe, faults.iterations)
+                        faults = faults.without_nan_in(lo, hi)
+                    lr_scale *= config.recovery_lr_backoff
+                    if not alpha_rederived:
+                        # re-derive α for the reliability actually realized:
+                        # the fault plan's alive/link expectation (runtime
+                        # degradation) or the schedule's own stored probs
+                        # (already effective under offline link thinning) —
+                        # effective_activation_probs finally feeding the
+                        # solver at run time instead of only in offline
+                        # studies
+                        alpha_rederived = True
+                        if faults is not None:
+                            from ..resilience import resolve_degraded_alpha
+
+                            new_alpha, new_rho, _ = resolve_degraded_alpha(
+                                schedule, faults)
+                        else:
+                            from ..schedule import solve_mixing_weight
+
+                            new_alpha, new_rho = solve_mixing_weight(
+                                schedule.laplacians(), schedule.probs)
+                        if abs(new_alpha - schedule.alpha) > 1e-9:
+                            recorder.log_fault(
+                                "alpha_rederived", epoch=epoch,
+                                old=float(schedule.alpha),
+                                new=float(new_alpha), rho=float(new_rho))
+                            schedule = dataclasses.replace(
+                                schedule, alpha=float(new_alpha))
+                    # rebuild the compiled programs against the updated
+                    # lr_scale / α / consumed fault arrays — the same recipe
+                    # setup used, so retries can never run a stale program
+                    _build_programs()
+                    recorder.log_fault(
+                        "rollback", epoch=epoch, reason=what,
+                        lr_scale=lr_scale, attempt=recoveries_used)
+                    state = snapshot
+                    snapshot = None
+                    continue  # retry this epoch from the last good state
                 # preserve the curve leading into the blow-up (flush beats the
                 # every-10-epochs cadence, which would drop up to 9 epochs)
                 recorder.add_epoch(
@@ -278,14 +441,18 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 )
                 if config.save:
                     recorder.save()
+                budget_note = (f", {recoveries_used}/{config.max_recoveries} "
+                               f"recoveries exhausted"
+                               if config.max_recoveries else "")
                 raise TrainingDiverged(
                     f"non-finite {what} in epoch {epoch} "
-                    f"(lr={config.lr}, communicator={config.communicator})"
+                    f"(lr={config.lr}, communicator={config.communicator}"
+                    f"{budget_note})"
                 )
 
         comm_time = comm_encode_time = 0.0
         if e_timer is not None:
-            window = schedule.flags[epoch * bpe : (epoch + 1) * bpe]
+            window = run_flags[epoch * bpe : (epoch + 1) * bpe]
             split = e_timer(state, window)
             comm_time = min(split["comm_time"], epoch_time)
             # encode is a component of comm_time, never exceeding it
@@ -296,11 +463,23 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         # the per-worker slice shrinks as workers grow or activation memory
         # blows past HBM (16-worker WRN-28-10 at 512 OOMs a 16 GB chip).
         test_loss = test_acc = np.zeros(config.num_workers)
+        eval_alive = None
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
             eval_batch = config.eval_batch or max(16, 1024 // config.num_workers)
             test_loss, test_acc = _evaluate_in_batches(
                 evaluate, state, dataset.x_test, dataset.y_test, batch=eval_batch
             )
+            if faults is not None:
+                # same quarantine exemption as the train-side metrics: a
+                # plan-dead worker's local state may legitimately be garbage
+                # (it will be healed at revival) — its eval entries become
+                # explicit NaN gaps instead of silently poisoning the tacc
+                # series and the test_*_mean history the sweep/verify
+                # consumers read
+                cur = max(min(int(state.step) - 1, faults.iterations - 1), 0)
+                eval_alive = faults.dead_alive[cur] > 0
+                test_loss = np.where(eval_alive, test_loss, np.nan)
+                test_acc = np.where(eval_alive, test_acc, np.nan)
 
         recorder.add_epoch(
             epoch_time=epoch_time,
@@ -314,29 +493,35 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         history.append({
             "epoch": epoch,
             **epoch_metrics,
-            "test_acc_mean": float(np.mean(test_acc)),
-            "test_loss_mean": float(np.mean(test_loss)),
+            "test_acc_mean": float(np.mean(test_acc[eval_alive])
+                                   if eval_alive is not None
+                                   and eval_alive.any() else np.mean(test_acc)),
+            "test_loss_mean": float(np.mean(test_loss[eval_alive])
+                                    if eval_alive is not None
+                                    and eval_alive.any() else np.mean(test_loss)),
             "epoch_time": epoch_time,
             "comm_time": comm_time,
             "comm_encode_time": comm_encode_time,
             "comm_exchange_time": comm_time - comm_encode_time,
         })
 
+        if faults is not None and float(epoch_metrics.get("healed", 0.0)) > 0:
+            recorder.log_fault(
+                "healed", epoch=epoch,
+                rows=float(epoch_metrics["healed"]) * bpe,
+                mean_alive=float(epoch_metrics.get("alive_workers",
+                                                   config.num_workers)))
+
         if config.save and recorder.epochs_recorded % 10 == 0:
             recorder.save()  # flush cadence parity (train_mpi.py:159-160)
         if config.checkpoint_every and (epoch + 1) % config.checkpoint_every == 0:
             save_checkpoint(f"{config.savePath}/{config.name}_ckpt", state,
-                            epoch, schedule=schedule)
+                            epoch, schedule=schedule0)
+        epoch += 1
 
     if config.save:
         recorder.save()
     return TrainResult(state, recorder, schedule, history)
-
-
-@jax.jit
-def _all_finite(tree) -> jax.Array:
-    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree_util.tree_leaves(tree)]
-    return jnp.all(jnp.stack(leaves))
 
 
 def _make_comm_timer(communicator, flattener, sample_steps: int = 32):

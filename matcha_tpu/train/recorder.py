@@ -6,11 +6,24 @@ written as ``dsgd-lr{lr}-budget{budget}-r{rank}-{kind}.log`` files plus an
 The seven reference series (recordtime, time, comptime, commtime, acc,
 losses, tacc) are kept and an eighth — ``disagreement``, the consensus error
 the reference never measures (SURVEY.md §5.5) — is added.
+
+Two resilience extensions:
+
+* a **fault ledger** — ``log_fault`` appends structured events (injected
+  faults, per-epoch heal counts, rollbacks, α re-derivations) that ``save``
+  writes as ``faults.json`` next to the CSVs; the plan verifier reads it to
+  score faulty runs against the *degraded* ρ instead of the fault-free one.
+* **resume alignment** — ``load_previous`` reads the on-disk series back
+  (truncated to the restored epoch) so a crash-resume extends the CSVs
+  instead of overwriting the pre-crash history.  (Rollback recovery needs
+  no recorder rewind: the loop detects divergence *before* the failed
+  epoch's row is added.)
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from typing import Dict, List
@@ -27,6 +40,7 @@ class Recorder:
         self.config = config
         self.num_workers = num_workers
         self.data: Dict[str, List] = {k: [] for k in SERIES}
+        self.faults: List[dict] = []
         self.start = time.time()
         self.folder = os.path.join(
             config.savePath, f"{config.name}_{config.model}"
@@ -55,6 +69,74 @@ class Recorder:
     def epochs_recorded(self) -> int:
         return len(self.data["time"])
 
+    def log_fault(self, kind: str, **detail):
+        """Append a structured event to the fault ledger (written to
+        ``faults.json`` by ``save``).  ``kind`` ∈ {"plan", "healed",
+        "rollback", "alpha_rederived", "emergency_checkpoint", ...} — the
+        ledger is a journal, not a schema."""
+        self.faults.append(
+            {"kind": kind, "recordtime": time.time() - self.start, **detail}
+        )
+
+    def load_previous(self, epochs: int) -> int:
+        """Reload up to ``epochs`` rows of a previous run's CSVs from disk.
+
+        The resume path calls this with the restored epoch count so that the
+        next ``save`` *extends* the on-disk series instead of overwriting
+        them with only the post-resume rows — without it, a crash-resume
+        silently decouples the CSV row index from the epoch number (and a
+        resume from an older checkpoint double-appends the replayed epochs).
+        The in-memory series always come back with exactly ``epochs`` rows:
+        whatever the CSVs hold (the flush cadence is every 10 epochs, so
+        they may lag a newer checkpoint) padded with NaN rows up to the
+        restored epoch.  Row index == epoch is the invariant every consumer
+        (plan verify's per-epoch factors, the sweep curves) relies on — a
+        silent 10-row file under a 15-epoch resume would shift every later
+        epoch by 5; an explicit NaN gap cannot be misread.  Returns the
+        number of rows actually read from disk (0 when no logs exist).
+        ``recordtime`` values are kept verbatim from the original run (they
+        are offsets from *that* run's start; documented, not rewritten).
+        The fault ledger is a journal, not a per-epoch series: its
+        pre-crash events are reloaded verbatim (so a resumed chaos run's
+        ``faults.json`` keeps the full rollback/heal history) and
+        post-resume events append after them."""
+        ledger = os.path.join(self.folder, "faults.json")
+        if os.path.exists(ledger):
+            with open(ledger) as f:
+                self.faults = list(json.load(f).get("events", []))
+        cfg = self.config
+        rows: Dict[str, List] = {k: [] for k in SERIES}
+        loaded = 0
+        complete = True
+        for kind in SERIES:
+            per_rank = []
+            for rank in range(self.num_workers):
+                path = os.path.join(
+                    self.folder,
+                    f"dsgd-lr{cfg.lr}-budget{cfg.budget}-r{rank}-{kind}.log")
+                if not os.path.exists(path):
+                    complete = False
+                    break
+                per_rank.append(np.loadtxt(path, delimiter=",", ndmin=1))
+            if not complete:
+                break
+            n = min(epochs, min(len(s) for s in per_rank))
+            loaded = n if kind == SERIES[0] else min(loaded, n)
+            stacked = np.stack([s[:n] for s in per_rank], axis=1)  # [n, N]
+            if kind in ("acc", "losses", "tacc"):
+                rows[kind] = [stacked[e] for e in range(n)]
+            else:  # scalar series: every rank holds the same value
+                rows[kind] = [float(stacked[e, 0]) for e in range(n)]
+        if not complete:
+            loaded, rows = 0, {k: [] for k in SERIES}
+        nan_row = np.full(self.num_workers, np.nan)
+        for kind in SERIES:
+            pad = float("nan") if kind not in ("acc", "losses", "tacc") \
+                else nan_row
+            rows[kind] = rows[kind][:loaded] + [pad] * (epochs - loaded)
+        self.data = rows
+        return int(loaded)
+
     def _series_for_worker(self, kind: str, rank: int) -> np.ndarray:
         rows = []
         for v in self.data[kind]:
@@ -76,3 +158,16 @@ class Recorder:
             f.write(f"{cfg.name} {cfg.description}\n")
             for field in dataclasses.fields(cfg):
                 f.write(f"{field.name}: {getattr(cfg, field.name)}\n")
+        path = os.path.join(self.folder, "faults.json")
+        if self.faults:
+            # atomic like the checkpoint sidecar: a crash mid-dump must not
+            # leave truncated JSON for the verifier to choke on
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"events": self.faults}, f, indent=1)
+            os.replace(tmp, path)
+        elif os.path.exists(path):
+            # a fault-free rerun into the same folder must not leave a
+            # previous run's ledger behind: plan-verify would silently score
+            # this run against the stale degraded rho
+            os.remove(path)

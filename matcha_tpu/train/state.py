@@ -102,6 +102,7 @@ def make_train_step(
     dropout: bool = False,
     lr_schedule: Optional[Callable] = None,
     grad_chunk: Optional[int] = None,
+    faults=None,
 ):
     """Build ``step(state, xb, yb[, rng]) -> (state, metrics)``.
 
@@ -109,6 +110,21 @@ def make_train_step(
     trace-time constant array indexed by ``state.step`` — the whole schedule
     compiles into the program (SURVEY.md §5.8) and survives checkpoint/resume
     through the step cursor.
+
+    ``faults``: optional ``resilience.RuntimeFaults`` — compiled fault-plan
+    arrays indexed by the same cursor, exactly like the flags.  When given,
+    each step (a) poisons the planned NaN-emitter rows, (b) detects
+    non-finite rows, quarantines them from gossip, and heals them (and
+    planned revivals) from the survivors' average — momentum and CHOCO-carry
+    rows of healed workers are reset, and their BatchNorm running statistics
+    are replaced by the donors' average (poisoned/stale stats cannot be
+    kept, and variance cannot be zero-reset), so a revived replica restarts
+    clean — and (c) runs the consensus transform under the survivor mask, so
+    every realized mixing matrix stays doubly stochastic over the alive
+    workers.  Link faults
+    are not handled here: the caller pre-multiplies ``flags`` by the plan's
+    ``link_up`` stream (both are static, so outages compile away).  With
+    ``faults=None`` the exact pre-resilience step compiles.
 
     ``grad_chunk``: workers whose forward/backward runs concurrently.  The
     default vmaps all N at once — peak activation memory scales with N·B,
@@ -120,6 +136,15 @@ def make_train_step(
     """
     flags_arr = jnp.asarray(np.asarray(flags), jnp.float32)  # [T, M]
     n_workers = flattener.num_workers
+    if faults is not None:
+        if faults.alive.shape != (flags_arr.shape[0], n_workers):
+            raise ValueError(
+                f"fault arrays {faults.alive.shape} do not match "
+                f"(iterations={flags_arr.shape[0]}, workers={n_workers}); "
+                f"compile the FaultPlan against this schedule")
+        alive_arr = jnp.asarray(faults.alive, jnp.float32)      # [T, N]
+        revive_arr = jnp.asarray(faults.revive, jnp.float32)    # [T, N]
+        inject_arr = jnp.asarray(faults.nan_inject, jnp.float32)
     if grad_chunk is not None and not (1 <= grad_chunk <= n_workers):
         raise ValueError(f"grad_chunk {grad_chunk} must be in [1, {n_workers}]")
     if grad_chunk is not None and n_workers % grad_chunk:
@@ -169,16 +194,73 @@ def make_train_step(
         # consensus transform on the flattened parameter stack
         flat = flattener.flatten(params)
         t = jnp.minimum(state.step, flags_arr.shape[0] - 1)
-        flat, carry = communicator.step(flat, state.comm_carry, flags_arr[t])
+        comm_carry = state.comm_carry
+        alive = None
+        if faults is not None:
+            from ..resilience.runtime import (
+                gossip_quarantined,
+                heal_and_mask,
+                heal_worker_stat_rows,
+                inject_nan_rows,
+                mask_worker_rows,
+            )
+
+            flat = inject_nan_rows(flat, inject_arr[t])
+            flat, alive, healed, row_finite = heal_and_mask(
+                flat, alive_arr[t], revive_arr[t])
+            keep = 1.0 - healed
+            opt_state = mask_worker_rows(opt_state, keep, n)
+            comm_carry = mask_worker_rows(comm_carry, keep, n)
+            # BN running stats can be neither kept (poisoned/stale) nor
+            # zero-reset (variance 0 is not neutral): the healed worker
+            # adopts the donors' statistics along with their parameters
+            new_stats = heal_worker_stat_rows(new_stats, healed,
+                                              alive * keep, n)
+        if alive is None:
+            flat, carry = communicator.step(flat, comm_carry, flags_arr[t])
+        else:
+            flat, carry = gossip_quarantined(
+                communicator.step, flat, comm_carry, flags_arr[t], alive,
+                gate=row_finite)
         params = flattener.unflatten(flat)
 
+        def _fleet_mean(v):
+            """Mean over workers — quarantined rows excluded under faults.
+
+            A plan-dead replica trains without consensus damping; its local
+            loss may legitimately blow up while quarantined (it will be
+            healed at revival).  Averaging it in would hand the divergence
+            detector a NaN for a fleet that is healthy by the quarantine
+            rules — the same exemption the full-state check applies.  NaN
+            rows are excluded with ``where`` (0·NaN leaks).  A step with
+            zero alive workers must not fabricate a perfect-looking 0.0:
+            it falls back to the mean over the finite local values (the
+            quarantined replicas are still computing), and to NaN — which
+            the detector will see — only when nothing finite exists."""
+            per_worker = v.reshape(v.shape[0], -1).mean(axis=1)
+            if alive is None:
+                return jnp.mean(per_worker)
+            kept = jnp.where(alive > 0, per_worker, 0.0)
+            fin = jnp.isfinite(per_worker).astype(per_worker.dtype)
+            local = jnp.where(
+                jnp.sum(fin) > 0,
+                jnp.sum(jnp.where(fin > 0, per_worker, 0.0))
+                / jnp.maximum(jnp.sum(fin), 1.0),
+                jnp.nan)
+            return jnp.where(jnp.sum(alive) > 0,
+                             jnp.sum(kept) / jnp.maximum(jnp.sum(alive), 1.0),
+                             local)
+
         metrics = {
-            "loss": jnp.mean(loss),
-            "accuracy": jnp.mean(top_k_accuracy(logits, yb)),
-            "disagreement": worker_disagreement(flat),
+            "loss": _fleet_mean(loss),
+            "accuracy": _fleet_mean(top_k_accuracy(logits, yb)),
+            "disagreement": worker_disagreement(flat, alive),
             "lr": lr_schedule(state.step) if lr_schedule else jnp.asarray(0.0),
             "active_matchings": jnp.sum(flags_arr[t]),
         }
+        if faults is not None:
+            metrics["healed"] = jnp.sum(healed)
+            metrics["alive_workers"] = jnp.sum(alive)
         return (
             state.replace(
                 params=params,

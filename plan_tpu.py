@@ -28,6 +28,14 @@ Subcommands
                 python plan_tpu.py verify --plan plan.json \
                     --run-dir runs/myrun_resnet20 --steps-per-epoch 32
 
+            When the run directory carries a fault ledger (``faults.json``,
+            written by training under a ``--fault-plan``), the bound is
+            automatically degraded to the run's alive/link expectations —
+            faulty runs are scored against the mixing they actually had,
+            not flagged with phantom violations.  ``rho`` accepts
+            ``--worker-alive`` / ``--link-drop`` for the same degraded view
+            offline.
+
 Everything here is host-side numpy/scipy — no JAX, no accelerator; a laptop
 plans for a pod.
 """
@@ -101,12 +109,42 @@ def _cost_model(args) -> CostModel:
 
 
 def cmd_rho(args) -> int:
+    # validate the cheap flags before the expensive candidate evaluation
+    # (the MC simulation dominates this command's cost)
+    if not 0.0 <= args.link_drop <= 1.0:
+        raise SystemExit(f"--link-drop must be in [0,1], got {args.link_drop}")
+    alive_vals = None
+    if args.worker_alive is not None:
+        alive_vals = [float(v) for v in args.worker_alive.split(",")]
+        if not all(0.0 <= v <= 1.0 for v in alive_vals):
+            raise SystemExit(f"--worker-alive values must be in [0,1], got "
+                             f"{args.worker_alive}")
     (spec,) = _topology_specs(args)
     decomposed, size, norm = resolve_topology(spec, args.seed)
     cand = plan_candidate(
         decomposed, size, args.budget, seed=args.seed, target=args.target,
         num_chips=args.chips, solver_iters=args.solver_iters,
         mc_trials=args.mc_trials, mc_steps=args.mc_steps, graph_spec=norm)
+    if alive_vals is not None or args.link_drop:
+        # degraded-fleet view: ρ of the expected mixing under per-worker
+        # availability and/or i.i.d. link drops (resilience fault model)
+        import numpy as np
+
+        from matcha_tpu.plan import degraded_contraction_rho
+        from matcha_tpu.topology import matching_laplacians
+
+        alive = None
+        if alive_vals is not None:
+            alive = np.asarray(alive_vals[0] if len(alive_vals) == 1
+                               else alive_vals)
+        cand["degraded"] = {
+            "worker_alive": None if alive is None else alive.tolist(),
+            "link_drop": args.link_drop,
+            "rho": degraded_contraction_rho(
+                matching_laplacians(decomposed, size),
+                np.asarray(cand["probs"]), cand["alpha"],
+                worker_alive=alive, link_up=1.0 - args.link_drop),
+        }
     print(json.dumps(cand, indent=1))
     return 0
 
@@ -217,6 +255,14 @@ def main(argv=None) -> int:
     sp = sub.add_parser("rho", help="contraction bound for one point")
     add_common(sp)
     sp.add_argument("--budget", type=float, default=0.5)
+    sp.add_argument("--worker-alive", default=None, dest="worker_alive",
+                    help="per-worker availability for the degraded-rho view: "
+                         "one float (uniform) or a comma list of N floats "
+                         "(a runtime fault plan's expected_alive)")
+    sp.add_argument("--link-drop", type=float, default=0.0, dest="link_drop",
+                    help="i.i.d. link drop probability for the degraded-rho "
+                         "view (matches schedule.with_link_failures / a "
+                         "flaky_link fault event)")
     sp.set_defaults(fn=cmd_rho)
 
     sp = sub.add_parser("simulate", help="Monte-Carlo consensus trajectory")

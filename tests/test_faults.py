@@ -14,6 +14,8 @@ from matcha_tpu.schedule import (
 )
 from matcha_tpu.train import TrainConfig, TrainingDiverged, train
 
+pytestmark = pytest.mark.faults
+
 
 def _sched(iterations=4000):
     dec = tp.decompose(tp.ring_graph(8), 8, seed=0)

@@ -1,0 +1,486 @@
+"""Runtime resilience: masked gossip, fault plans, self-healing, rollback
+recovery (DESIGN.md §8).  The `faults` marker lets this matrix run as its own
+lane (``pytest -m faults``) without deselecting it from tier-1."""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matcha_tpu import topology as tp
+from matcha_tpu.communicator import make_choco, make_decen
+from matcha_tpu.parallel import (
+    dense_gossip_fn,
+    gossip_mix,
+    gossip_mix_skip,
+    worker_disagreement,
+)
+from matcha_tpu.resilience import (
+    FaultEvent,
+    FaultPlan,
+    heal_and_mask,
+    load_fault_plan,
+    state_finite_rows,
+)
+from matcha_tpu.schedule import fixed_schedule, matcha_schedule
+from matcha_tpu.train import TrainConfig, TrainingDiverged, train
+
+pytestmark = pytest.mark.faults
+
+
+def _sched(gid=5, iterations=20, budget=0.75, seed=0):
+    size = tp.graph_size(gid)
+    return matcha_schedule(tp.select_graph(gid), size, iterations,
+                           budget=budget, seed=seed), size
+
+
+BASE = TrainConfig(
+    name="res", model="mlp", dataset="synthetic", num_workers=8, graphid=5,
+    batch_size=16, epochs=3, lr=0.1, warmup=False, matcha=True, budget=0.75,
+    seed=3, save=False, eval_every=1, measure_comm_split=False,
+)
+
+
+# --------------------------------------------------------------- fault plans
+
+def test_fault_plan_compiles_to_expected_arrays():
+    plan = FaultPlan(events=(
+        FaultEvent(kind="dead", worker=2, start=5, stop=9),
+        FaultEvent(kind="straggler", worker=4, start=0, stop=8, period=4),
+        FaultEvent(kind="nan", worker=1, start=7),
+        FaultEvent(kind="link_down", matching=0, start=3, stop=6),
+        FaultEvent(kind="flaky_link", start=10, stop=20, drop_prob=0.5,
+                   seed=1),
+    ))
+    rf = plan.compile(20, 8, 3)
+    assert rf.alive.shape == (20, 8) and rf.link_up.shape == (20, 3)
+    # dead window + revival exactly at stop
+    assert rf.alive[5:9, 2].sum() == 0 and rf.alive[9, 2] == 1
+    assert rf.revive[9, 2] == 1 and rf.revive.sum() == 1  # stragglers never
+    # straggler participates only every period-th step of its range
+    np.testing.assert_array_equal(rf.alive[0:8, 4],
+                                  [1, 0, 0, 0, 1, 0, 0, 0])
+    # ...but is NOT in the dead-only mask: stragglers are never healed, so
+    # the divergence detector must not exempt them on their off-steps
+    assert rf.dead_alive[:, 4].all()
+    assert not rf.dead_alive[5:9, 2].any()
+    # nan default stop = one step
+    assert rf.nan_inject[7, 1] == 1 and rf.nan_inject[:, 1].sum() == 1
+    assert rf.link_up[3:6, 0].sum() == 0 and rf.link_up[2, 0] == 1
+    # flaky: deterministic under seed, roughly the declared rate
+    rf2 = plan.compile(20, 8, 3)
+    np.testing.assert_array_equal(rf.link_up, rf2.link_up)
+    drop = 1 - rf.link_up[10:20].mean()
+    assert 0.2 < drop < 0.8
+    # expectations feed the degraded-rho predictor
+    assert rf.expected_alive()[2] == pytest.approx(16 / 20)
+    assert rf.any_faults()
+    # consuming a window's nan events clears exactly that window
+    assert rf.without_nan_in(0, 20).nan_inject.sum() == 0
+    assert rf.without_nan_in(8, 20).nan_inject.sum() == 1
+
+
+def test_fault_plan_json_roundtrip_and_validation(tmp_path):
+    plan = FaultPlan(events=(
+        FaultEvent(kind="dead", worker=0, start=0, stop=4),
+        FaultEvent(kind="flaky_link", start=0, drop_prob=0.3, seed=2),
+    ), name="roundtrip")
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(plan.to_json()))
+    again = load_fault_plan(str(path))
+    assert again == plan
+    assert load_fault_plan(plan.to_json()) == plan
+    assert load_fault_plan(list(plan.events)).events == plan.events
+    with pytest.raises(ValueError, match="kind"):
+        FaultEvent(kind="meteor", start=0)
+    with pytest.raises(ValueError, match="worker"):
+        FaultEvent(kind="dead", start=0)
+    with pytest.raises(ValueError, match="period"):
+        FaultEvent(kind="straggler", worker=0, start=0, period=1)
+    with pytest.raises(ValueError, match="range"):
+        FaultPlan(events=(FaultEvent(kind="dead", worker=9, start=0),)) \
+            .compile(10, 8, 2)
+
+
+# ------------------------------------------------------------- masked gossip
+
+@pytest.mark.parametrize("gid", [0, 2, 5])
+@pytest.mark.parametrize("mask_seed", [0, 1, 2])
+def test_masked_realized_mixing_is_doubly_stochastic(gid, mask_seed):
+    """Property: ANY alive mask yields a realized W whose rows and columns
+    sum to 1 (doubly stochastic over survivors), symmetric, with dead rows
+    exactly e_i — the invariant that keeps gossip mean-preserving and the
+    MATCHA contraction argument valid under worker loss."""
+    sched, size = _sched(gid=gid, iterations=8, budget=0.6, seed=4)
+    rng = np.random.default_rng(mask_seed)
+    alive = (rng.random(size) > 0.4).astype(np.float32)
+    if mask_seed == 1:
+        alive[:] = 1.0  # all-alive must reduce to the unmasked operator
+    if mask_seed == 2:
+        alive[:] = 0.0
+        alive[0] = 1.0  # single survivor: W must be the identity
+    fn = jax.jit(dense_gossip_fn(sched.laplacians()))
+    eye = jnp.eye(size)
+    for t in [0, 3, 7]:
+        w = sched.alpha * jnp.asarray(sched.flags[t], jnp.float32)
+        W = np.asarray(fn(eye, w, jnp.asarray(alive)))
+        np.testing.assert_allclose(W.sum(1), 1.0, atol=1e-6)
+        np.testing.assert_allclose(W.sum(0), 1.0, atol=1e-6)
+        np.testing.assert_allclose(W, W.T, atol=1e-6)
+        for i in np.flatnonzero(alive == 0):
+            np.testing.assert_allclose(W[i], np.eye(size)[i], atol=1e-7)
+        if alive.all():
+            np.testing.assert_allclose(W, sched.mixing_matrix_at(t),
+                                       atol=1e-6)
+
+
+def test_masked_backends_agree_and_quarantine():
+    sched, size = _sched(iterations=6)
+    x = np.random.default_rng(0).normal(size=(size, 17)).astype(np.float32)
+    alive = np.ones(size, np.float32)
+    alive[[2, 6]] = 0
+    aj = jnp.asarray(alive)
+    w = sched.alpha * jnp.asarray(sched.flags[0], jnp.float32)
+    a = np.asarray(gossip_mix(jnp.asarray(x), sched.perms, w, aj))
+    b = np.asarray(dense_gossip_fn(sched.laplacians())(jnp.asarray(x), w, aj))
+    c = np.asarray(jax.jit(
+        lambda xx, ww, al: gossip_mix_skip(xx, sched.perms, ww, al)
+    )(jnp.asarray(x), w, aj))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-5)
+    # dead rows are untouched, and survivors never read dead values: the
+    # output of alive rows is invariant to arbitrary garbage in dead rows
+    np.testing.assert_array_equal(a[[2, 6]], x[[2, 6]])
+    x2 = x.copy()
+    x2[[2, 6]] = 1e6
+    a2 = np.asarray(gossip_mix(jnp.asarray(x2), sched.perms, w, aj))
+    keep = alive > 0
+    np.testing.assert_allclose(a2[keep], a[keep], rtol=1e-5, atol=1e-4)
+
+
+def test_masked_gossip_contracts_survivors():
+    sched, size = _sched(iterations=200)
+    alive = np.ones(size, np.float32)
+    alive[5] = 0
+    comm = make_decen(sched, backend="dense")
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(size, 32)),
+                    jnp.float32)
+    out, _ = jax.jit(lambda xx, f: comm.run(xx, f, alive=jnp.asarray(alive)))(
+        x, sched.flags)
+    d0 = float(worker_disagreement(x, jnp.asarray(alive)))
+    dT = float(worker_disagreement(out, jnp.asarray(alive)))
+    assert dT < 1e-3 * d0
+    # the dead row rode along untouched
+    np.testing.assert_array_equal(np.asarray(out)[5], np.asarray(x)[5])
+    # survivor mean preserved (masked mixing is doubly stochastic over them)
+    keep = alive > 0
+    np.testing.assert_allclose(np.asarray(out)[keep].mean(0),
+                               np.asarray(x)[keep].mean(0), atol=1e-4)
+
+
+def test_choco_masked_keeps_dead_worker_unobservable():
+    """An alive worker's CHOCO output must be invariant to a dead peer's
+    parameter values (messages are edge-masked both directions)."""
+    sched, size = _sched(iterations=5)
+    comm = make_choco(sched, ratio=0.5, consensus_lr=0.3, backend="batched")
+    alive = np.ones(size, np.float32)
+    alive[3] = 0
+    x = np.random.default_rng(4).normal(size=(size, 40)).astype(np.float32)
+    run = jax.jit(lambda xx, f: comm.run(xx, f, alive=jnp.asarray(alive)))
+    a, _ = run(jnp.asarray(x), sched.flags)
+    x2 = x.copy()
+    x2[3] = -77.0
+    b, _ = run(jnp.asarray(x2), sched.flags)
+    keep = alive > 0
+    np.testing.assert_allclose(np.asarray(a)[keep], np.asarray(b)[keep],
+                               rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------- healing primitives
+
+def test_heal_and_mask_heals_nan_rows_from_survivors():
+    flat = jnp.asarray(np.arange(24, dtype=np.float32).reshape(6, 4))
+    flat = flat.at[2].set(jnp.nan)
+    alive = jnp.ones(6)
+    healed_flat, ok, healed, finite = heal_and_mask(flat, alive, jnp.zeros(6))
+    assert float(healed[2]) == 1 and float(healed.sum()) == 1
+    survivors = np.delete(np.arange(6), 2)
+    np.testing.assert_allclose(np.asarray(healed_flat)[2],
+                               np.asarray(flat)[survivors].mean(0))
+    assert np.asarray(ok).tolist() == [1, 1, 1, 1, 1, 1]
+    assert np.asarray(finite).tolist() == [1, 1, 1, 1, 1, 1]
+    # revival heals a finite row too (fresh params for a rejoining worker) —
+    # from its PEERS' average: the revived worker's own stale row must not
+    # vote on where it rejoins
+    revived_flat, _, healed2, _ = heal_and_mask(healed_flat, alive,
+                                                jnp.eye(6)[4])
+    assert float(healed2[4]) == 1
+    peers = np.delete(np.arange(6), 4)
+    np.testing.assert_allclose(np.asarray(revived_flat)[4],
+                               np.asarray(healed_flat)[peers].mean(0))
+
+
+def test_heal_worker_stat_rows_adopts_donor_statistics():
+    """BN running stats of a healed worker are replaced by the donors'
+    average (not zeroed — variance 0 is not neutral — and not kept)."""
+    from matcha_tpu.resilience import heal_worker_stat_rows
+
+    stats = {"bn": {"var": jnp.asarray([[2.0], [4.0], [jnp.nan], [6.0]])}}
+    healed = jnp.asarray([0.0, 0.0, 1.0, 0.0])
+    donors = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+    out = heal_worker_stat_rows(stats, healed, donors, 4)
+    np.testing.assert_allclose(np.asarray(out["bn"]["var"]).ravel(),
+                               [2.0, 4.0, 4.0, 6.0])
+    # empty stats trees (models without BN) pass through untouched
+    assert heal_worker_stat_rows({}, healed, donors, 4) == {}
+
+
+def test_mask_worker_rows_resets_nan_rows():
+    """The reset must be a where, not a multiply: the row being zeroed may
+    hold the very NaN (overflowed momentum) that triggered the heal, and
+    0·NaN = NaN would let it survive its own reset."""
+    from matcha_tpu.resilience import mask_worker_rows
+
+    tree = {"trace": jnp.ones((4, 3)).at[1].set(jnp.nan),
+            "count": jnp.zeros((), jnp.int32),
+            "key": jnp.zeros((2,), jnp.uint32)}
+    keep = jnp.asarray([1.0, 0.0, 1.0, 1.0])  # reset the poisoned row 1
+    out = mask_worker_rows(tree, keep, 4)
+    np.testing.assert_array_equal(np.asarray(out["trace"])[1], 0.0)
+    np.testing.assert_array_equal(np.asarray(out["trace"])[0], 1.0)
+    assert out["count"].dtype == jnp.int32  # non-float leaves untouched
+
+
+def test_heal_without_quorum_leaves_poison_quarantined():
+    """All-NaN: no survivor quorum — healing must NOT zero the model; the
+    rows stay non-finite (for the epoch-level detector) but masked out."""
+    flat = jnp.full((4, 3), jnp.nan)
+    out, ok, healed, finite = heal_and_mask(flat, jnp.ones(4), jnp.zeros(4))
+    assert float(healed.sum()) == 0 and float(ok.sum()) == 0
+    assert float(finite.sum()) == 0
+    assert not np.isfinite(np.asarray(out)).any()
+
+
+def test_state_finite_rows_sees_momentum_and_carry():
+    """Satellite: the divergence detector must cover the full TrainState —
+    an Inf living only in optimizer momentum is invisible to a params-only
+    check until an epoch later."""
+    state = {
+        "params": {"w": jnp.ones((4, 3))},
+        "opt_state": {"trace": jnp.ones((4, 3)).at[1, 0].set(jnp.inf)},
+        "comm_carry": {"x_hat": jnp.zeros((4, 2))},
+        "step": jnp.zeros((), jnp.int32),  # int leaves are skipped
+    }
+    mask = np.asarray(state_finite_rows(state, 4))
+    assert mask.tolist() == [True, False, True, True]
+    state["comm_carry"]["x_hat"] = jnp.zeros((4, 2)).at[3, 1].set(jnp.nan)
+    assert np.asarray(state_finite_rows(state, 4)).tolist() == \
+        [True, False, True, False]
+
+
+# ------------------------------------------------------------- e2e training
+
+def test_train_chaos_ring_survives_and_heals():
+    """Acceptance: mid-training dead worker + 20% link drops on the 8-ring
+    completes without raising, heals the quarantined worker, and survivor
+    disagreement lands within 2x of the fault-free run."""
+    plan = FaultPlan(events=(
+        FaultEvent(kind="dead", worker=3, start=16, stop=32),
+        FaultEvent(kind="nan", worker=5, start=20),
+        FaultEvent(kind="flaky_link", start=0, drop_prob=0.2, seed=7),
+    ))
+    chaos = train(dataclasses.replace(BASE, fault_plan=plan))
+    ctl = train(BASE)
+    assert len(chaos.history) == 3
+    assert np.isfinite(chaos.history[-1]["loss"])
+    # epoch 1 ran with worker 3 quarantined; the NaN emitter was healed
+    assert chaos.history[1]["alive_workers"] == pytest.approx(7.0)
+    kinds = [e["kind"] for e in chaos.recorder.faults]
+    assert "plan" in kinds and "healed" in kinds
+    # eval metrics honor the quarantine: the dead worker's tacc entry for
+    # epoch 1 is an explicit NaN gap, and the survivor mean stays finite
+    assert np.isnan(np.asarray(chaos.recorder.data["tacc"][1])[3])
+    assert np.isfinite(chaos.history[1]["test_acc_mean"])
+    # final epoch: everyone revived, disagreement within 2x of fault-free
+    assert chaos.history[-1]["alive_workers"] == pytest.approx(8.0)
+    assert chaos.history[-1]["disagreement"] <= \
+        2.0 * ctl.history[-1]["disagreement"] + 1e-8
+    # the healed worker's parameters rejoined the fleet consensus
+    leaf = jax.tree_util.tree_leaves(chaos.state.params)[0]
+    rows = np.asarray(leaf).reshape(8, -1)
+    fleet = rows.mean(0)
+    dead_dist = np.linalg.norm(rows[3] - fleet)
+    typical = np.median([np.linalg.norm(rows[i] - fleet) for i in range(8)])
+    assert dead_dist <= 5 * (typical + 1e-6)
+
+
+def test_train_forced_nan_recovers_via_rollback():
+    """Acceptance: an uncontained NaN epoch (every worker poisoned — no heal
+    quorum) rolls back to the last good state, backs off the LR, consumes
+    the chaos event, and finishes with finite loss."""
+    plan = FaultPlan(events=tuple(
+        FaultEvent(kind="nan", worker=w, start=20) for w in range(8)))
+    r = train(dataclasses.replace(BASE, fault_plan=plan, max_recoveries=2))
+    assert [h["epoch"] for h in r.history] == [0, 1, 2]
+    assert np.isfinite(r.history[-1]["loss"])
+    events = {e["kind"]: e for e in r.recorder.faults}
+    assert events["rollback"]["epoch"] == 1
+    assert events["rollback"]["lr_scale"] == pytest.approx(0.5)
+
+
+def test_train_recovery_budget_is_bounded():
+    """A fault the retries cannot outrun (every step re-poisons the fleet)
+    must exhaust the bounded budget and raise, not loop forever."""
+    plan = FaultPlan(events=tuple(
+        FaultEvent(kind="nan", worker=w, start=0, stop=10 ** 6)
+        for w in range(8)))
+    with pytest.raises(TrainingDiverged, match="recoveries exhausted"):
+        train(dataclasses.replace(BASE, epochs=2, fault_plan=plan,
+                                  max_recoveries=1))
+
+
+def test_resilience_config_validation():
+    with pytest.raises(ValueError, match="max_recoveries"):
+        dataclasses.replace(BASE, max_recoveries=-1)
+    with pytest.raises(ValueError, match="halt_on_divergence"):
+        dataclasses.replace(BASE, max_recoveries=1, halt_on_divergence=False)
+    with pytest.raises(ValueError, match="recovery_lr_backoff"):
+        dataclasses.replace(BASE, recovery_lr_backoff=0.0)
+    with pytest.raises(ValueError, match="fault_plan"):
+        dataclasses.replace(BASE, communicator="none",
+                            fault_plan=FaultPlan(events=()))
+
+
+# ------------------------------------------------- recorder resume alignment
+
+def test_recorder_resume_extends_instead_of_rewriting(tmp_path):
+    """Satellite: recorder flush and checkpoint cadences are independent;
+    resuming must reload the on-disk series truncated to the restored epoch
+    so the CSVs stay one-row-per-epoch instead of losing (or duplicating)
+    the pre-crash history."""
+    cfg = dataclasses.replace(BASE, epochs=4, checkpoint_every=2, save=True,
+                              savePath=str(tmp_path))
+    r1 = train(cfg)
+    folder = tmp_path / f"{cfg.name}_{cfg.model}"
+    log = folder / f"dsgd-lr{cfg.lr}-budget{cfg.budget}-r0-losses.log"
+    orig = np.loadtxt(log, delimiter=",", ndmin=1)
+    assert len(orig) == 4
+    # resume from the latest checkpoint (epoch 3) for 2 more epochs
+    cfg2 = dataclasses.replace(cfg, epochs=6, checkpoint_every=0)
+    r2 = train(cfg2, resume_dir=f"{cfg.savePath}/{cfg.name}_ckpt")
+    assert r2.history[0]["epoch"] == 4
+    now = np.loadtxt(log, delimiter=",", ndmin=1)
+    assert len(now) == 6  # 4 originals + 2 new, not 2, not 10
+    np.testing.assert_allclose(now[:4], orig)
+    # per-worker series stay aligned too
+    tacc = folder / f"dsgd-lr{cfg.lr}-budget{cfg.budget}-r5-tacc.log"
+    assert len(np.loadtxt(tacc, delimiter=",", ndmin=1)) == 6
+
+
+def test_recorder_load_previous_pads_lagging_series(tmp_path):
+    """CSV flushes lag checkpoints (every-10-epoch cadence): resume must pad
+    the gap with explicit NaN rows so row index == epoch always holds, never
+    silently shift later epochs into the gap."""
+    from matcha_tpu.train import Recorder
+
+    cfg = dataclasses.replace(BASE, savePath=str(tmp_path))
+    rec = Recorder(cfg, cfg.num_workers)
+    for e in range(2):
+        rec.add_epoch(epoch_time=1.0, comp_time=1.0, comm_time=0.0,
+                      train_acc=np.full(8, 0.5), train_loss=np.full(8, 1.0),
+                      test_acc=np.zeros(8), disagreement=0.1)
+    rec.save()
+    rec2 = Recorder(cfg, cfg.num_workers)
+    assert rec2.load_previous(5) == 2  # only 2 rows existed on disk
+    assert rec2.epochs_recorded == 5  # padded to the restored epoch
+    losses = [np.asarray(v) for v in rec2.data["losses"]]
+    assert np.isfinite(losses[0]).all() and np.isfinite(losses[1]).all()
+    assert all(np.isnan(np.asarray(v)).all() for v in losses[2:])
+
+
+# ------------------------------------------------------------ degraded rho
+
+def test_degraded_rho_monotone_and_consistent():
+    from matcha_tpu.plan import degraded_contraction_rho
+    from matcha_tpu.schedule import contraction_rho
+
+    sched, size = _sched(iterations=4, budget=0.5)
+    Ls = sched.laplacians()
+    p = np.asarray(sched.probs)
+    base = contraction_rho(Ls, p, sched.alpha)
+    # no degradation == base bound
+    assert degraded_contraction_rho(Ls, p, sched.alpha) == \
+        pytest.approx(base, abs=1e-12)
+    assert degraded_contraction_rho(Ls, p, sched.alpha, worker_alive=1.0,
+                                    link_up=1.0) == pytest.approx(base,
+                                                                  abs=1e-12)
+    # killing a worker or dropping links can only slow the contraction
+    alive = np.ones(size)
+    alive[0] = 0.0
+    dead_rho = degraded_contraction_rho(Ls, p, sched.alpha,
+                                        worker_alive=alive)
+    drop_rho = degraded_contraction_rho(Ls, p, sched.alpha, link_up=0.8)
+    assert dead_rho > base and drop_rho > base
+    # a permanently dead worker is projected out: the bound is on SURVIVOR
+    # consensus (ring minus one node = a path — still contracts, strictly
+    # slower), not pinned at the vacuous full-space 1.0
+    assert dead_rho < 1.0 - 1e-6
+    # a *fractionally* alive worker (revives mid-run) stays in
+    part = np.ones(size)
+    part[0] = 0.5
+    part_rho = degraded_contraction_rho(Ls, p, sched.alpha,
+                                        worker_alive=part)
+    assert base < part_rho < 1.0
+    # degenerate fleets: nothing left to bound
+    assert degraded_contraction_rho(Ls, p, sched.alpha,
+                                    worker_alive=np.eye(size)[0]) == 1.0
+
+
+def test_with_link_failures_stores_effective_probs():
+    """Satellite: the thinned schedule must carry the degraded activation
+    probabilities so every probs consumer scores the mixing that actually
+    runs."""
+    from matcha_tpu.schedule import with_link_failures
+
+    sched, _ = _sched(iterations=50, budget=0.75)
+    dropped = with_link_failures(sched, 0.3, seed=1)
+    np.testing.assert_allclose(np.asarray(dropped.probs),
+                               np.asarray(sched.probs) * 0.7)
+    # and the spectral view sees the slower mixing
+    assert dropped.expected_rho() > sched.expected_rho()
+    assert dropped.alpha == sched.alpha  # frozen by contract (documented)
+
+
+def test_verify_plan_scores_faulty_runs_against_degraded_rho(tmp_path):
+    """plan verify honesty: with a fault ledger in the run dir, the bound
+    compared against the Recorder series is the degraded one."""
+    from matcha_tpu.plan import PlanArtifact, verify_plan_run
+    from matcha_tpu.plan.autotune import plan_candidate, resolve_topology
+
+    decomposed, size, norm = resolve_topology({"graphid": 5}, 0)
+    cand = plan_candidate(decomposed, size, 0.5, seed=0, graph_spec=norm)
+    artifact = PlanArtifact(chosen=cand, candidates=[cand],
+                            target_consensus=1e-3, num_chips=1,
+                            cost_model={})
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    d = 0.5 ** np.arange(6)
+    np.savetxt(run_dir / "dsgd-lr0.1-budget0.5-r0-disagreement.log", d,
+               delimiter=",")
+    alive = [1.0] * size
+    alive[2] = 0.5
+    (run_dir / "faults.json").write_text(json.dumps({"events": [{
+        "kind": "plan", "name": "chaos",
+        "expected_alive": alive, "expected_link_up": [0.8] * len(cand["probs"]),
+    }]}))
+    report = verify_plan_run(artifact, str(run_dir), steps_per_epoch=16)
+    assert report["faults"]["rho_fault_free"] == pytest.approx(cand["rho"])
+    assert report["rho"] > cand["rho"]  # degraded bound is weaker
+    # without the ledger the fault-free rho is used
+    (run_dir / "faults.json").unlink()
+    report2 = verify_plan_run(artifact, str(run_dir), steps_per_epoch=16)
+    assert report2["rho"] == pytest.approx(cand["rho"])
+    assert "faults" not in report2

@@ -112,6 +112,22 @@ def parse_args(argv=None) -> TrainConfig:
                    help="workers per fwd/bwd slab (0 = all at once); caps "
                         "activation memory when folding many virtual "
                         "workers per chip")
+    p.add_argument("--fault-plan", default=None, dest="fault_plan",
+                   help="JSON fault plan (resilience.FaultPlan): dead "
+                        "workers, stragglers, NaN emitters, link outages "
+                        "over step ranges, injected deterministically into "
+                        "the SPMD step; e.g. "
+                        '\'{"events": [{"kind": "dead", "worker": 3, '
+                        '"start": 100, "stop": 200}]}\' in a file')
+    p.add_argument("--max-recoveries", type=int, default=0,
+                   dest="max_recoveries",
+                   help="on a non-finite epoch: roll back to the last good "
+                        "state, back off the LR, re-derive alpha for the "
+                        "degraded links, and retry up to this many times "
+                        "before raising (0 = historical abort-on-NaN)")
+    p.add_argument("--recovery-lr-backoff", type=float, default=0.5,
+                   dest="recovery_lr_backoff",
+                   help="LR scale applied per recovery attempt")
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--resume", default=None, help="checkpoint dir to resume from")
     p.add_argument("--eval-every", type=int, default=1)
@@ -149,6 +165,8 @@ def parse_args(argv=None) -> TrainConfig:
         gossip_backend=args.backend, gossip_block_d=args.block_d,
         gossip_w_window=args.w_window, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
+        fault_plan=args.fault_plan, max_recoveries=args.max_recoveries,
+        recovery_lr_backoff=args.recovery_lr_backoff,
         eval_every=args.eval_every,
         eval_batch=args.eval_batch,
         fixed_mode=args.fixed_mode,
