@@ -207,6 +207,59 @@ def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
     return max(rates)
 
 
+def overlap_wire_grid(sched, x, steps, n, dim, backend="dense", reps=2,
+                      time_left=None):
+    """The overlap × wire-dtype grid (ISSUE 4 tentpole): gossip-chain rate
+    and wire bytes for every (eager|pipelined) × (f32|bf16) cell.
+
+    ``overlap="1step"`` drives ``Communicator.run_overlapped`` — the exact
+    software-pipelined schedule the train loop runs (issue at t, consume at
+    t+1), arithmetically the same W-chain after its drain.  On a single
+    chip the pipeline cannot buy wall-clock (there is no ICI to hide), so
+    the CPU cells validate mechanics and the bytes accounting; the
+    *speedup* claim waits for a live multi-chip window
+    (benchmarks/tpu_session.sh step 1.5).  ``bytes_per_step`` is the dense
+    roofline traffic model at the cell's wire width — bf16 halves it; the
+    state rides in the wire dtype end-to-end like every dense/fused bench
+    measurement (master-params-f32 is a *training-loop* property, modeled
+    there, not in the chain microbench).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu.communicator import make_decen
+
+    steps = min(steps, len(sched.flags))
+    flags = jnp.asarray(np.asarray(sched.flags)[:steps], jnp.float32)
+    cells = []
+    for wire in ("f32", "bf16"):
+        comm = make_decen(sched, backend=backend, wire_dtype=wire)
+        xw = x.astype(jnp.bfloat16 if wire == "bf16" else jnp.float32)
+        for overlap in ("off", "1step"):
+            if time_left is not None and time_left() < 10.0:
+                # no silent caps: the emitted grid says what was dropped
+                print(f"# overlap grid truncated at {len(cells)}/4 cells: "
+                      f"{time_left():.0f}s left", file=sys.stderr)
+                return cells
+            runner = comm.run if overlap == "off" else comm.run_overlapped
+            run = jax.jit(lambda v, r=runner: jnp.sum(
+                r(v, flags)[0][:, :8].astype(jnp.float32)))
+            float(run(xw))  # compile + warmup (forced readback, see above)
+            rates = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(run(xw))
+                rates.append(steps / (time.perf_counter() - t0))
+            bytes_el = 2 if wire == "bf16" else 4
+            cells.append({
+                "overlap": overlap, "wire_dtype": wire,
+                "value": round(max(rates), 1),
+                "unit": "gossip_steps_per_sec",
+                "bytes_per_step": (2.0 * n * dim + n * n) * bytes_el,
+            })
+    return cells
+
+
 def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1):
     """Per-step FLOP and HBM-byte model for the MXU backends, evaluated at
     the measured rate.  The fused kernel's traffic model is derived in
@@ -285,7 +338,30 @@ def worker_main(args) -> int:
         }
         if args.backend == "dense":
             record.update(roofline("dense", value, n, dim, args.dtype))
+        # flush the measured record BEFORE the grid refinement: if the grid
+        # dies (or the provisional clock kills the process mid-grid) the
+        # parent salvages this line — the measurement must never be
+        # gambled on a refinement (same protocol as the fused path)
         print(json.dumps(record))
+        sys.stdout.flush()
+        if (args.backend == "dense" and args.overlap_grid_steps
+                and time_left() > 30.0):
+            # budget-aware chain length: the grid runs 4 cells × (warmup
+            # + 2 reps) = 12 chains, and a grid cell's scanned
+            # run/run_overlapped chain measures ~2-3× slower than the
+            # single-backend rate just measured — budget for 36 equivalent
+            # chains so the whole grid stays inside ~60 s even on the
+            # 1-core CPU provisional; time_left() re-checks between cells
+            budget = min(60.0, max(time_left() - 30.0, 0.0))
+            gsteps = max(2, min(args.overlap_grid_steps, steps,
+                                int(value * budget / 36)))
+            try:
+                record["overlap_grid"] = overlap_wire_grid(
+                    sched, x, gsteps, n, dim, time_left=time_left)
+                print(json.dumps(record))
+            except Exception as e:  # noqa: BLE001 — grid is a refinement
+                print(f"# overlap grid failed: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
         return 0
 
     # --- primary: per-step (training-regime) fused kernel, chunk=1 ---------
@@ -387,6 +463,24 @@ def worker_main(args) -> int:
     print(json.dumps(record))
     sys.stdout.flush()
 
+    # --- overlap × wire-dtype grid (pipelined schedule + narrowed wire) ----
+    # dense per-step cells: the regime the overlapped *training* loop runs
+    # (one W_t @ x per SGD step); the bf16 cells must show bytes_per_step
+    # halved, the 1step cells validate the pipelined chain end-to-end
+    if args.overlap_grid_steps and time_left() > 45.0:
+        try:
+            record["overlap_grid"] = overlap_wire_grid(
+                sched, x, args.overlap_grid_steps, n, dim,
+                time_left=time_left)
+            print(json.dumps(record))
+            sys.stdout.flush()
+        except Exception as e:  # noqa: BLE001 — grid is a refinement
+            print(f"# overlap grid failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+    elif args.overlap_grid_steps:
+        print(f"# overlap grid skipped: {time_left():.0f}s left",
+              file=sys.stderr)
+
     # --- secondary: chunked chain composition (consensus-only regime) ------
     if args.chunk > 1 and time_left() < 45.0:
         print(f"# chunked secondary skipped: {time_left():.0f}s left",
@@ -459,10 +553,16 @@ def orchestrate(args, passthrough) -> int:
     # exists regardless of what the TPU tunnel does.  Full-size state and
     # schedule, dense f32 backend, few steps (the CPU is 1 core; the point is
     # a real, honest-if-slow number, not throughput).
+    # the deadline makes the worker's time_left() real: without it the
+    # provisional's optional grid refinement would budget against infinity
+    # while the subprocess clock (provisional_timeout) could SIGKILL it
+    # mid-grid; 15 s slack covers teardown + the parent's read
     cpu_cmd = [sys.executable, me, "--in-process", "--force-cpu",
                "--backend", "dense",
                "--dtype", "f32", "--steps", str(args.cpu_steps),
-               "--workers", str(args.workers)]
+               "--workers", str(args.workers),
+               "--deadline", str(time.time() + args.provisional_timeout - 15.0),
+               "--overlap-grid-steps", str(args.overlap_grid_steps)]
     if args.smoke:
         cpu_cmd.append("--smoke")
     rc, out, err, timed_out, secs = _run_bounded(
@@ -664,6 +764,12 @@ def main():
                         "the best rate (early-exits once the north star is "
                         "reached; identical per-step arithmetic at every "
                         "candidate). Empty string disables.")
+    p.add_argument("--overlap-grid-steps", type=int, default=200,
+                   dest="overlap_grid_steps",
+                   help="chain length per overlap × wire-dtype grid cell "
+                        "(the pipelined/bf16-wire sweep; 0 disables). The "
+                        "grid rides the dense per-step regime — the one the "
+                        "overlapped training loop runs")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=240.0,
                    help="wall-clock bound per TPU measurement attempt (s)")
@@ -721,7 +827,8 @@ def main():
                     "--chunk", str(args.chunk), "--block-d", str(args.block_d),
                     "--chunk-block-d", str(args.chunk_block_d),
                     "--w-window", str(args.w_window),
-                    "--w-sweep", args.w_sweep]
+                    "--w-sweep", args.w_sweep,
+                    "--overlap-grid-steps", str(args.overlap_grid_steps)]
     if args.force_attempt_failure:  # test hook rides only the TPU attempts;
         passthrough.append("--force-attempt-failure")  # the provisional stays real
     return orchestrate(args, passthrough)

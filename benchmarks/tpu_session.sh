@@ -18,12 +18,39 @@ set -x
 #    provisional); the gate only gates the *expensive tuning* steps below.
 timeout -k 30 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_RC=$?
 
-# 1. THE driver artifact: per-step primary + chunked secondary (≤ ~9 min);
+# 1. THE driver artifact: per-step primary + chunked secondary + the
+#    overlap × wire-dtype grid (bench.py now emits `overlap_grid` by
+#    default: eager|1step × f32|bf16 cells with rate + bytes_per_step);
 #    runs even on a broken tunnel (bounded attempts + CPU provisional).
-#    capture_live persists an on-TPU record as bench_live_r5.json — the
-#    committed hardware evidence the fallback path cites.
-python benchmarks/capture_live.py --round 5
+#    capture_live persists an on-TPU record as bench_live_r6.json — the
+#    committed hardware evidence the fallback path cites, now carrying the
+#    combined overlap+bf16 speedup as the headline ask of this window.
+python benchmarks/capture_live.py --round 6
 [ "$GATE_RC" -eq 0 ] || { echo "gate failed (rc=$GATE_RC): skipping tuning steps"; exit 1; }
+
+# 1.5 overlap × wire-dtype at the *training* regime: the pipelined train
+#     step (--overlap 1step) only pays off where there is ICI to hide —
+#     time eager vs pipelined, f32 vs bf16 wire, on whatever mesh the
+#     window exposes (--backend auto: shard_map on a multi-chip mesh,
+#     dense on a single chip — the step must still land evidence on the
+#     1-chip windows every round so far has had).  Cheap (4 short runs);
+#     the per-epoch JSON lines are PERSISTED as the committable artifact —
+#     a headline number that only scrolls past in the session log is the
+#     promissory-claim failure mode tests/test_docs_artifacts.py exists
+#     to prevent.
+rm -f benchmarks/overlap_sweep_r6.jsonl
+# one bounded device-count probe, hoisted: jax.devices() is exactly the RPC
+# the tunnel's stall mode wedges, so it must never run unwrapped (and never
+# 4 times) inside the loop
+DEVS=$(timeout -k 10 120 python -c 'import jax; print(len(jax.devices()))' 2>/dev/null)
+for ov in off 1step; do for wd in f32 bf16; do
+    echo "{\"sweep\": \"overlap-x-wire r6\", \"overlap\": \"$ov\", \"wire_dtype\": \"$wd\", \"devices\": \"$DEVS\"}" \
+        >> benchmarks/overlap_sweep_r6.jsonl
+    timeout -k 30 420 python train_tpu.py --name "ovgrid-$ov-$wd" \
+        --model mlp --dataset synthetic --graphid 2 --numworkers 16 \
+        --epoch 3 --backend auto --overlap "$ov" --wire-dtype "$wd" \
+        --no-comm-split >> benchmarks/overlap_sweep_r6.jsonl
+done; done
 
 # Every step below is timeout-wrapped: the tunnel's observed failure mode
 # (r4) is a mid-RPC stall that hangs the client forever — an unwrapped step

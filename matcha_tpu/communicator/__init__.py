@@ -29,6 +29,7 @@ def select_communicator(
     seed: int = 0,
     block_d: int | None = None,
     w_window: int = 1,
+    wire_dtype=None,
 ) -> Communicator:
     """Registry keyed by the reference's algorithm names (README.md:17-53):
     ``decen`` (D-PSGD/MATCHA), ``choco`` (CHOCO-SGD), ``centralized``
@@ -36,10 +37,13 @@ def select_communicator(
     compressor from the ops registry (``matcha_tpu.ops.COMPRESSOR_NAMES``);
     ``seed`` seeds the stochastic compressors' PRNG carry.  ``block_d`` and
     ``w_window`` tune the fused Pallas kernel (decen only; see
-    :func:`make_decen`)."""
+    :func:`make_decen`).  ``wire_dtype`` (``"f32"``/``"bf16"``) narrows the
+    exchanged tensors at the gossip boundary for every communicator except
+    ``none`` (which exchanges nothing)."""
     if name == "decen":
         return make_decen(schedule, mesh=mesh, backend=backend,
-                          block_d=block_d, w_window=w_window)
+                          block_d=block_d, w_window=w_window,
+                          wire_dtype=wire_dtype)
     if block_d is not None or w_window != 1:
         import warnings
 
@@ -59,9 +63,10 @@ def select_communicator(
         choco_backend = backend if backend in ("auto", "shard_map") else "batched"
         return make_choco(schedule, ratio=ratio, consensus_lr=consensus_lr,
                           mesh=mesh, backend=choco_backend,
-                          compressor=compressor, seed=seed)
+                          compressor=compressor, seed=seed,
+                          wire_dtype=wire_dtype)
     if name == "centralized":
-        return make_centralized()
+        return make_centralized(wire_dtype=wire_dtype)
     if name == "none":
         return make_none()
     raise KeyError(f"unknown communicator '{name}'")

@@ -12,6 +12,28 @@ is a *pure function pair* compatible with ``jit``/``scan``:
 persistent algorithm state (CHOCO's ``x_hat``/``s``) so checkpointing them is
 trivial — the state the reference would silently lose on restart
 (SURVEY.md §5.4).
+
+Two-phase contract (overlapped pipelining, DESIGN.md §11)
+---------------------------------------------------------
+``step`` fuses *exchange* and *apply* into one transform, which puts the
+gossip collectives on the critical path of every training step.  The
+two-phase split breaks that dependence:
+
+    delta, c' = comm.begin_mix(flat, carry, flags_t[, alive])  # issue
+    flat'     = comm.apply_mix(flat, delta)                    # consume
+
+``begin_mix`` performs the whole exchange for this step and returns the
+*mixing delta* ``step(flat)[0] − flat`` instead of the mixed state;
+``apply_mix`` is a pure elementwise add.  A pipelined train loop issues
+``begin_mix`` at step *t* and applies the delta at step *t+1* — the
+collective then has no consumer inside step *t+1*'s forward/backward, so
+XLA is free to overlap ICI traffic with compute (arXiv:2410.11998's
+overlap condition).  Because every mixing transform here preserves the
+worker mean (doubly stochastic ``W``; CHOCO's telescoping ``s``/``x̂``),
+the delta has exactly zero column-mean — applying it a step late never
+moves the fleet average, only the per-worker spread (MATCHA's one-step
+staleness argument: the contraction factor is perturbed, not the
+convergence structure; see ``plan.spectral.stale_contraction_rho``).
 """
 
 from __future__ import annotations
@@ -55,6 +77,89 @@ class Communicator:
     step: StepFn
     multi_step: Any = None  # Optional[(flat, carry, flags[T,M]) -> (flat, carry)]
     encode_probe: Any = None  # Optional[(flat, probe_state) -> probe_state]
+
+    def begin_mix(self, flat: jax.Array, carry: Any, flags_t: jax.Array,
+                  alive: Any = None):
+        """Issue this step's exchange; returns ``(delta, carry')``.
+
+        ``delta = step(flat)[0] − flat`` — all collectives (ppermute /
+        gathers / the dense matmul) execute here; what crosses the phase
+        boundary is a plain ``[N, D]`` array with zero column-mean.  The
+        default derivation from ``step`` is exact for every backend: decen's
+        delta is ``Σ_j w_j(x[π_j] − x)`` (the axpy accumulator itself),
+        CHOCO's is ``γ·(s − x̂)``, centralized's is ``x̄ − x``.  Carry
+        advances at *issue* time, so a pipelined chain threads carries
+        identically to an eager one.
+        """
+        if alive is None:
+            mixed, carry = self.step(flat, carry, flags_t)
+        else:
+            mixed, carry = self.step(flat, carry, flags_t, alive)
+        return mixed - flat, carry
+
+    def apply_mix(self, flat: jax.Array, delta: jax.Array) -> jax.Array:
+        """Consume a ``begin_mix`` delta: a pure elementwise add, no
+        collectives — safe to fuse into the next step's update math."""
+        return flat + delta
+
+    def run_overlapped(self, flat: jax.Array, flags: jax.Array,
+                       carry: Any = None, alive: Any = None,
+                       drain: bool = True):
+        """Scan the two-phase pipeline over a flag stream.
+
+        Step *t* applies the delta issued at *t−1*, then issues its own —
+        the software-pipelined schedule the overlapped train loop runs.  On
+        a pure consensus chain (nothing mutates ``flat`` between issue and
+        apply) the drained pipeline reproduces ``run`` *exactly*: the delta
+        issued on ``x`` and applied to the same ``x`` is one eager step by
+        construction.  (Exactly in real arithmetic — at f32 wire the fp
+        difference is reassociation noise, ~1 ulp/step; a *quantizing* wire
+        re-rounds the slightly different state, so bf16 drain-vs-eager
+        agreement holds only to the 2⁻⁸-per-step noise scale the
+        ``stale_contraction_rho`` budget already covers.)
+        ``drain=True`` applies the final in-flight delta so
+        the result is the full T-step chain; ``drain=False`` returns the
+        visible (one-mix-behind) state plus the pending delta, which is
+        what an epoch boundary in the pipelined train loop holds.
+
+        ``alive``: optional ``f32[N]`` (constant) or ``f32[T, N]``
+        (per-step) survivor mask, forwarded to ``begin_mix``.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        if carry is None:
+            carry = self.init(flat)
+        flags = jnp.asarray(flags, jnp.float32)
+        pending = jnp.zeros_like(flat)
+        if flags.shape[0] == 0:
+            return (self.apply_mix(flat, pending), carry) if drain \
+                else (flat, carry, pending)
+
+        if alive is not None:
+            alive = jnp.asarray(alive, jnp.float32)
+
+        def body(state, xs):
+            x, c, pend = state
+            flags_t, alive_t = xs
+            x = self.apply_mix(x, pend)
+            pend, c = self.begin_mix(x, c, flags_t, alive_t)
+            return (x, c, pend), None
+
+        if alive is None or alive.ndim == 1:
+            a = alive  # None or constant row: closed over, not scanned
+
+            def body_const(state, flags_t):
+                return body(state, (flags_t, a))
+
+            (x, c, pending), _ = lax.scan(
+                body_const, (flat, carry, pending), flags)
+        else:
+            (x, c, pending), _ = lax.scan(
+                body, (flat, carry, pending), (flags, alive))
+        if drain:
+            return self.apply_mix(x, pending), c
+        return x, c, pending
 
     def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None,
             alive: Any = None):

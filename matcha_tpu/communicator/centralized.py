@@ -10,27 +10,47 @@ axis is sharded.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
-from ..parallel import allreduce_mean, masked_allreduce_mean
+from ..parallel import (
+    allreduce_mean,
+    masked_allreduce_mean,
+    masked_mean_rows,
+    resolve_wire_dtype,
+)
 from .base import Communicator
 
 __all__ = ["make_centralized", "make_none"]
 
 
-def make_centralized() -> Communicator:
+def make_centralized(wire_dtype=None) -> Communicator:
     """With a survivor mask, the average runs over alive rows only and dead
     rows are left untouched (quarantined) — the AllReduce analogue of gossip
-    self-loops, so a dead worker's stale parameters never drag the fleet."""
+    self-loops, so a dead worker's stale parameters never drag the fleet.
+
+    ``wire_dtype``: the all-reduced operand is quantized to the wire dtype
+    before averaging (what each worker would put on the wire); the mean is
+    accumulated in f32 and quarantined rows keep their *unquantized* local
+    parameters — the wire narrows the exchange, never the master state."""
+    wire = resolve_wire_dtype(wire_dtype)
 
     def init(flat: jax.Array):
         return ()
 
     def step(flat: jax.Array, carry, flags_t: jax.Array, alive=None):
+        flat_w = flat if wire is None else flat.astype(wire).astype(flat.dtype)
         if alive is None:
-            return allreduce_mean(flat), carry
-        return masked_allreduce_mean(flat, alive), carry
+            return allreduce_mean(flat_w), carry
+        if wire is None:
+            return masked_allreduce_mean(flat, alive), carry
+        mean = masked_mean_rows(flat_w, alive)
+        w = alive.reshape((alive.shape[0],) + (1,) * (flat.ndim - 1))
+        return jnp.where(w > 0, jnp.broadcast_to(mean, flat.shape),
+                         flat), carry
 
-    return Communicator(name="centralized", init=init, step=step)
+    name = "centralized" if wire is None \
+        else f"centralized[wire={jnp.dtype(wire).name}]"
+    return Communicator(name=name, init=init, step=step)
 
 
 def make_none() -> Communicator:

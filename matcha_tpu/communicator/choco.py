@@ -109,6 +109,7 @@ def make_choco(
     backend: str = "auto",
     compressor: str = "top_k",
     seed: int = 0,
+    wire_dtype=None,
 ) -> Communicator:
     """Build the CHOCO communicator.
 
@@ -127,16 +128,38 @@ def make_choco(
     backends draw *different* key streams (per-array vs per-chip fold-in):
     bit-parity across backends holds only for the ``DETERMINISTIC_COMPRESSORS``
     (``top_k``, ``top_k_approx``), which carry no key at all.
+
+    ``wire_dtype`` (``"f32"``/``"bf16"``/None): the compressed *values* are
+    quantized to the wire dtype once, right after ``compress`` — every
+    consumer (the neighbor exchange, the self message, and the ``x̂``
+    update) reads the same quantized values, so this is exactly CHOCO with
+    a ``quantize ∘ top-k`` compressor (still a δ-contraction) rather than a
+    drifting wire approximation: what a worker applies to ``x̂`` is what its
+    neighbors received.  In the shard_map backend the ICI ``ppermute``
+    moves the values at the wire dtype (lossless re-cast: they are already
+    wire-representable), halving the compressed message bytes; indices stay
+    int32 either way.
     """
+    from ..parallel import resolve_wire_dtype
+
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
     M, N = perms.shape
+    wire = resolve_wire_dtype(wire_dtype)
     # partner masks: fixed points exchange nothing (communicator.py:210)
     partnered = (perms != np.arange(N)[None, :]).astype(np.float32)  # [M, N]
     nonempty = [bool(partnered[j].any()) for j in range(M)]
-    compress = select_compressor(compressor)
+    base_compress = select_compressor(compressor)
+    if wire is None:
+        compress = base_compress
+    else:
+        def compress(q, ratio_, key):
+            vals, idx = base_compress(q, ratio_, key)
+            return vals.astype(wire).astype(q.dtype), idx
     stochastic = compressor not in DETERMINISTIC_COMPRESSORS
     cname = f"choco[r{ratio}" + ("" if compressor == "top_k" else f",{compressor}")
+    if wire is not None:
+        cname += f",wire={jnp.dtype(wire).name}"
 
     if backend == "auto":
         backend = "shard_map" if (mesh is not None and mesh.size > 1) else "batched"
@@ -228,7 +251,14 @@ def make_choco(
                     yv, yi = vals, idx
                 else:
                     pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
-                    yv = lax.ppermute(vals, axis, pairs)
+                    if wire is None:
+                        yv = lax.ppermute(vals, axis, pairs)
+                    else:
+                        # values are already wire-representable (quantized at
+                        # compress): the narrow ppermute is lossless and
+                        # halves the compressed message bytes on ICI
+                        yv = lax.ppermute(vals.astype(wire), axis,
+                                          pairs).astype(vals.dtype)
                     yi = lax.ppermute(idx, axis, pairs)
                 src = jnp.asarray(part.src_local)[c]  # [L]
                 m = jnp.asarray(part.mask)[c]  # [L]

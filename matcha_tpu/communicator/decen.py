@@ -31,6 +31,7 @@ from ..parallel import (
     dense_gossip_fn,
     gossip_mix,
     gossip_mix_skip,
+    resolve_wire_dtype,
     shard_map_gossip_fn,
 )
 from ..schedule import Schedule
@@ -47,6 +48,7 @@ def make_decen(
     chunk: int = 1,
     block_d: int | None = None,
     w_window: int = 1,
+    wire_dtype=None,
 ) -> Communicator:
     """Build the gossip communicator for a schedule.
 
@@ -89,9 +91,25 @@ def make_decen(
     visit.  Unlike ``chunk`` this keeps the exact per-step arithmetic (every
     step's matmul executes in order) — it only amortizes grid overhead and
     enlarges W DMAs, so it is valid for the training-regime measurement.
+
+    ``wire_dtype`` (``"f32"``/``"bf16"``/None): dtype of the *exchanged*
+    tensors at the gossip boundary — bf16 halves the bytes every backend
+    moves per step (ppermute blocks on ICI for shard_map, the HBM state
+    stream for gather/skip, the MXU operand pass for dense/fused) while
+    master parameters and the delta accumulation stay f32.  For the MXU
+    backends this rides the existing ``compute_dtype``/``mxu_precision``
+    seam: bf16 wire ⇒ one native bf16 MXU pass with f32 accumulation
+    (``preferred_element_type``); f32 wire keeps the exact HIGHEST-precision
+    program.  An explicit ``compute_dtype`` below f32 wins over the wire
+    knob (the bench passes bf16 state directly).
     """
     perms = np.asarray(schedule.perms)
     alpha = float(schedule.alpha)
+    wire = resolve_wire_dtype(wire_dtype)
+    if wire is not None and jnp.dtype(compute_dtype).itemsize >= 4:
+        # the dense/fused matmul *is* the exchange: its operand pass in the
+        # wire dtype (f32 accumulate) is exactly the bf16-wire semantics
+        compute_dtype = wire
 
     if backend == "auto":
         backend = "shard_map" if (mesh is not None and mesh.size > 1) else "dense"
@@ -122,12 +140,14 @@ def make_decen(
                 f"oracle tests.",
                 stacklevel=2,
             )
-        mix: Callable = lambda x, w, alive=None: gossip_mix(x, perms, w, alive)
+        mix: Callable = lambda x, w, alive=None: gossip_mix(
+            x, perms, w, alive, wire_dtype=wire)
     elif backend == "skip":
         if mesh is not None and mesh.size > 1:
-            mix = shard_map_gossip_fn(perms, mesh, skip=True)
+            mix = shard_map_gossip_fn(perms, mesh, skip=True, wire_dtype=wire)
         else:
-            mix = lambda x, w, alive=None: gossip_mix_skip(x, perms, w, alive)
+            mix = lambda x, w, alive=None: gossip_mix_skip(
+                x, perms, w, alive, wire_dtype=wire)
     elif backend == "dense":
         mix = dense_gossip_fn(schedule.laplacians(), compute_dtype=compute_dtype)
     elif backend == "fused":
@@ -157,7 +177,7 @@ def make_decen(
     elif backend == "shard_map":
         if mesh is None:
             raise ValueError("shard_map backend needs a mesh")
-        mix = shard_map_gossip_fn(perms, mesh)
+        mix = shard_map_gossip_fn(perms, mesh, wire_dtype=wire)
     else:
         raise KeyError(f"unknown gossip backend '{backend}'")
 
@@ -169,6 +189,8 @@ def make_decen(
             return mix(flat, alpha * flags_t), carry
         return mix(flat, alpha * flags_t, alive), carry
 
+    wire_tag = "" if wire is None else f",wire={jnp.dtype(wire).name}"
     return Communicator(
-        name=f"decen[{backend}]", init=init, step=step, multi_step=multi_step
+        name=f"decen[{backend}{wire_tag}]", init=init, step=step,
+        multi_step=multi_step,
     )

@@ -16,6 +16,7 @@ from .gossip import (
     gossip_mix_skip,
     gossip_mix_folded,
     masked_laplacians,
+    resolve_wire_dtype,
     shard_map_gossip_fn,
 )
 from .mesh import WORKER_AXIS, fold_dims, replicated, shard_workers, worker_mesh
@@ -50,6 +51,7 @@ __all__ = [
     "masked_laplacians",
     "masked_mean_rows",
     "replicated",
+    "resolve_wire_dtype",
     "shard_map_gossip_fn",
     "shard_workers",
     "worker_mesh",

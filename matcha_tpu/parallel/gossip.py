@@ -69,8 +69,32 @@ __all__ = [
     "build_folded_plan",
     "gossip_mix_folded",
     "mxu_precision",
+    "resolve_wire_dtype",
     "shard_map_gossip_fn",
 ]
+
+
+def resolve_wire_dtype(wire_dtype):
+    """Normalize the wire-dtype knob to ``None`` (exact f32 program) or a
+    jnp dtype the exchange casts to at the gossip boundary.
+
+    ``"f32"``/``None`` compile the exact legacy program (no casts anywhere);
+    ``"bf16"`` halves every exchanged byte: the permuted/gathered operand —
+    the thing that actually crosses ICI in the folded plan, or streams
+    through HBM in the single-chip forms — is bf16, while master parameters
+    and the delta accumulation stay f32 (the ``mxu_precision`` seam's
+    contract).  A jnp dtype passes through untouched.
+    """
+    if wire_dtype is None:
+        return None
+    if isinstance(wire_dtype, str):
+        if wire_dtype in ("f32", "float32"):
+            return None
+        if wire_dtype in ("bf16", "bfloat16"):
+            return jnp.bfloat16
+        raise ValueError(f"unknown wire_dtype '{wire_dtype}' (f32|bf16)")
+    dt = jnp.dtype(wire_dtype)
+    return None if dt == jnp.dtype(jnp.float32) else dt
 
 
 def mxu_precision(compute_dtype) -> lax.Precision:
@@ -91,7 +115,8 @@ def _rows(mask: jax.Array, x: jax.Array) -> jax.Array:
 
 
 def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array,
-               alive: jax.Array | None = None) -> jax.Array:
+               alive: jax.Array | None = None,
+               wire_dtype=None) -> jax.Array:
     """``x_i + Σ_j weights[j]·(x[π_j(i)] − x_i)`` over the leading axis.
 
     ``perms`` must be a *static* numpy ``int32[M, N]`` (part of the compiled
@@ -104,16 +129,26 @@ def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array,
     ``alive``: optional traced ``f32[N]`` survivor mask — each edge's delta
     is additionally scaled by ``alive_i·alive_{π_j(i)}`` (see module
     docstring), keeping the realized mixing doubly stochastic over survivors.
+
+    ``wire_dtype`` (see :func:`resolve_wire_dtype`): exchanged values are
+    quantized once, *before* the permutes, and the delta is formed from the
+    quantized values on both endpoints — edge (i, j) then contributes
+    ``w·(x̃_j − x̃_i)`` to row i and exactly ``−`` that to row j (IEEE
+    ``a − b == −(b − a)``), so pairwise cancellation — and with it exact
+    worker-mean preservation — survives the bf16 wire bit-for-bit.  The
+    accumulation into f32 ``x`` stays f32.
     """
     perms = np.asarray(perms)
     if perms.ndim != 2 or perms.shape[1] != x.shape[0]:
         raise ValueError(f"perms {perms.shape} incompatible with x {x.shape}")
+    wire = resolve_wire_dtype(wire_dtype)
+    xw = x if wire is None else x.astype(wire).astype(x.dtype)
     acc = jnp.zeros_like(x)
     for j in range(perms.shape[0]):
         pi = perms[j]
         if np.all(pi == np.arange(pi.shape[0])):
             continue  # empty matching: zero delta regardless of flag
-        delta = x[pi] - x
+        delta = xw[pi] - xw
         if alive is not None:
             delta = _rows(alive * alive[pi], delta) * delta
         acc = acc + weights[j] * delta
@@ -121,7 +156,8 @@ def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array,
 
 
 def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array,
-                    alive: jax.Array | None = None) -> jax.Array:
+                    alive: jax.Array | None = None,
+                    wire_dtype=None) -> jax.Array:
     """``gossip_mix`` with per-matching ``lax.cond`` instead of masking:
     an inactive matching costs *nothing at runtime* (XLA compiles both
     branches but executes only the taken one), so the MATCHA budget buys
@@ -150,6 +186,8 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array,
     perms = np.asarray(perms)
     if perms.ndim != 2 or perms.shape[1] != x.shape[0]:
         raise ValueError(f"perms {perms.shape} incompatible with x {x.shape}")
+    wire = resolve_wire_dtype(wire_dtype)
+    xw = x if wire is None else x.astype(wire).astype(x.dtype)
     out = x
     for j in range(perms.shape[0]):
         pi = perms[j]
@@ -157,7 +195,7 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array,
             continue
 
         def exchange(o, w=weights[j], p=pi):
-            delta = x[p] - x
+            delta = xw[p] - xw
             if alive is not None:
                 delta = _rows(alive * alive[p], delta) * delta
             return o + w * delta
@@ -351,6 +389,7 @@ def gossip_mix_folded(
     axis: str = WORKER_AXIS,
     skip: bool = False,
     alive: jax.Array | None = None,
+    wire_dtype=None,
 ) -> jax.Array:
     """Per-chip body of the folded gossip step; call inside ``shard_map``.
 
@@ -373,11 +412,23 @@ def gossip_mix_folded(
     ``alive[own row]·alive[partner row]``; the ``ppermute`` pattern itself
     stays static (a dead chip's block still circulates, weighted to zero),
     which is what keeps the collective schedule deadlock-free under faults.
+
+    ``wire_dtype``: the ``ppermute`` operand — the bytes that actually ride
+    ICI — is cast to this dtype before the exchange (bf16 halves every
+    inter-chip hop), and the delta is formed from the quantized values on
+    *both* endpoints in f32, so edge-pairwise cancellation (exact
+    worker-mean preservation) survives the narrow wire; the f32 block
+    accumulation is untouched.
     """
     C = plan.num_chips
     L = plan.rows_per_chip
     c = lax.axis_index(axis)
     alive2d = None if alive is None else alive.reshape(C, L)
+    wire = resolve_wire_dtype(wire_dtype)
+    # xw: the wire image of this chip's block — what ppermute moves and what
+    # both sides of every delta read, cast back to f32 once per step
+    xw_wire = x_blk if wire is None else x_blk.astype(wire)
+    xw = x_blk if wire is None else xw_wire.astype(x_blk.dtype)
     acc = jnp.zeros_like(x_blk)
     for j, parts in enumerate(plan.matchings):
 
@@ -385,10 +436,10 @@ def gossip_mix_folded(
             delta = jnp.zeros_like(x_blk)
             for part in parts:
                 if part.offset == 0:
-                    y = x_blk
+                    y = xw
                 else:
                     pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
-                    y = lax.ppermute(x_blk, axis, pairs)
+                    y = lax.ppermute(xw_wire, axis, pairs).astype(x_blk.dtype)
                 src = jnp.asarray(part.src_local)[c]  # [L]
                 m = jnp.asarray(part.mask)[c]  # [L]
                 if alive2d is not None:
@@ -396,7 +447,7 @@ def gossip_mix_folded(
                     # lives on chip c+offset, at its local row `src`)
                     m = m * alive2d[c] * alive2d[(c + part.offset) % C][src]
                 # masks partition all L slots ⇒ Σ_parts m·y[src] == x[π_j]
-                delta = delta + _rows(m, x_blk) * (y[src] - x_blk)
+                delta = delta + _rows(m, x_blk) * (y[src] - xw)
             return delta
 
         if skip:
@@ -422,13 +473,14 @@ def import_shard_map():
 
 
 def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS,
-                        skip: bool = False):
+                        skip: bool = False, wire_dtype=None):
     """Build a jittable ``(x[N,...], weights[M][, alive[N]]) -> x[N,...]``
     gossip function running as an explicit shard_map over ``mesh``.  ``skip``
     forwards to :func:`gossip_mix_folded` (cond-skip inactive matchings'
-    collectives).  ``alive=None`` traces the exact unmasked program; a
-    survivor mask is passed replicated (``P()``), so every chip gates its
-    edges identically."""
+    collectives); ``wire_dtype`` likewise (bf16 halves the ppermute bytes on
+    ICI).  ``alive=None`` traces the exact unmasked program; a survivor mask
+    is passed replicated (``P()``), so every chip gates its edges
+    identically."""
     from jax.sharding import PartitionSpec as P
 
     shard_map = import_shard_map()
@@ -437,11 +489,12 @@ def shard_map_gossip_fn(perms: np.ndarray, mesh, axis: str = WORKER_AXIS,
     plan = build_folded_plan(np.asarray(perms), C)
 
     def body(x_blk, weights):
-        return gossip_mix_folded(x_blk, plan, weights, axis=axis, skip=skip)
+        return gossip_mix_folded(x_blk, plan, weights, axis=axis, skip=skip,
+                                 wire_dtype=wire_dtype)
 
     def body_masked(x_blk, weights, alive):
         return gossip_mix_folded(x_blk, plan, weights, axis=axis, skip=skip,
-                                 alive=alive)
+                                 alive=alive, wire_dtype=wire_dtype)
 
     def fn(x, weights, alive=None):
         spec = P(axis, *([None] * (x.ndim - 1)))

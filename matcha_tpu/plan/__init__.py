@@ -49,7 +49,10 @@ from .spectral import (
     empirical_contraction_rate,
     masked_laplacian_expectation,
     simulate_consensus,
+    stale_contraction_rho,
     steps_to_consensus,
+    wire_disagreement_floor,
+    wire_quantization_eps,
 )
 from .verify import (
     load_fault_ledger,
@@ -78,8 +81,11 @@ __all__ = [
     "resolve_topology",
     "save_plan",
     "simulate_consensus",
+    "stale_contraction_rho",
     "steps_to_consensus",
     "sweep",
     "verify_against_recorder",
     "verify_plan_run",
+    "wire_disagreement_floor",
+    "wire_quantization_eps",
 ]

@@ -45,7 +45,37 @@ __all__ = [
     "masked_laplacian_expectation",
     "degraded_contraction_rho",
     "degraded_solver_inputs",
+    "stale_contraction_rho",
+    "wire_disagreement_floor",
+    "wire_quantization_eps",
 ]
+
+
+def wire_quantization_eps(wire_dtype) -> float:
+    """Relative rounding bound of one wire-dtype quantization.
+
+    bf16 keeps 8 significand bits (7 explicit + the implicit leading 1), so
+    round-to-nearest introduces at most ``2⁻⁸`` relative error per exchanged
+    value — the bound the bf16-wire parity test pins against the executor
+    and the ``stale_contraction_rho`` noise model consumes.  f32 wire (or
+    ``None``) is the exact program: ε = 0.  Accepts the same spellings as
+    the executor's ``parallel.gossip.resolve_wire_dtype`` — strings or
+    dtype objects — and doubles as the validator every predictor entry
+    point calls up front.
+    """
+    if wire_dtype in (None, "f32", "float32"):
+        return 0.0
+    if wire_dtype in ("bf16", "bfloat16"):
+        return 2.0 ** -8
+    try:  # dtype objects (np.float32, ml_dtypes/jnp bfloat16): match by name
+        name = np.dtype(wire_dtype).name
+    except TypeError:
+        name = None
+    if name == "float32":
+        return 0.0
+    if name == "bfloat16":
+        return 2.0 ** -8
+    raise ValueError(f"unknown wire_dtype '{wire_dtype}' (f32|bf16)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +131,8 @@ def simulate_consensus(
     dim: int = 4,
     seed: int = 0,
     laplacians: Optional[np.ndarray] = None,
+    overlap: str = "off",
+    wire_dtype=None,
 ) -> ConsensusSim:
     """Simulate ``x ← W_t x`` under sampled Bernoulli activation flags.
 
@@ -110,12 +142,28 @@ def simulate_consensus(
     ``dim`` independent columns per trial cheapen the variance reduction:
     the consensus error sums over columns, so one trial already averages
     ``dim`` random directions.
+
+    ``overlap="1step"`` simulates the *pipelined* recurrence the overlapped
+    train loop runs (``Communicator.run_overlapped``): step *t* applies the
+    delta issued at *t−1*, then issues its own — the measured trajectory is
+    the visible (one-mix-behind) state.  The pending delta is renormalized
+    alongside ``x`` (the recurrence is linear, so the joint rescaling is
+    exact) and ``rho_bound`` comes from :func:`stale_contraction_rho`, which
+    must bound the empirical rate exactly as the eager bound does.
+    ``wire_dtype="bf16"`` rounds the exchanged state through the wire dtype
+    before each ``W`` application, mirroring the executor's boundary cast.
     """
+    if overlap not in ("off", "1step"):
+        raise ValueError(f"overlap must be 'off' or '1step', got {overlap!r}")
+    # validates wire_dtype up front: a bad spelling must fail here, not
+    # after the trials×steps MC loop has already been paid for
+    quantizing = wire_quantization_eps(wire_dtype) > 0.0
     if laplacians is None:
         laplacians = matching_laplacians(decomposed, size)
     Ls = np.asarray(laplacians, dtype=np.float64)
     p = np.asarray(probs, dtype=np.float64)
     eye = np.eye(size)
+    pipelined = overlap == "1step"
 
     log_errors = np.zeros((trials, steps + 1), dtype=np.float64)
     for trial in range(trials):
@@ -124,17 +172,35 @@ def simulate_consensus(
         x = _consensus_component(rng.standard_normal((size, dim)))
         norm = math.sqrt(float(np.sum(x * x)))
         x /= max(norm, 1e-300)
+        pending = np.zeros_like(x)
         log_e = 0.0
         for t in range(steps):
             W = eye - alpha * np.tensordot(
                 flags[t].astype(np.float64), Ls, axes=1
             )
-            x = _consensus_component(W @ x)  # re-project: guards fp drift
+            if pipelined:
+                x = x + pending  # consume the exchange issued at t−1
+                xw = _wire_quantize(x, wire_dtype) if quantizing else x
+                pending = W @ xw - xw  # issue this step's exchange
+                x = _consensus_component(x)
+            elif not quantizing:
+                x = _consensus_component(W @ x)  # re-project: guards fp drift
+            else:
+                xw = _wire_quantize(x, wire_dtype)
+                # the wire rounds only the *exchanged* delta; the local term
+                # x stays exact — mirrors x + (W−I)x̃ in the executor
+                x = _consensus_component(x + (W @ xw - xw))
             e = float(np.sum(x * x))  # ‖x − x̄‖² of the unit-normalized state
             log_e += math.log(max(e, 1e-300))
             log_errors[trial, t + 1] = log_e
-            x /= max(math.sqrt(e), 1e-300)  # renormalize: no underflow ever
-    rho = contraction_rho(Ls, p, float(alpha))
+            scale = max(math.sqrt(e), 1e-300)
+            x /= scale  # renormalize: no underflow ever
+            if pipelined:
+                pending /= scale  # joint rescale: the recurrence is linear
+    rho = stale_contraction_rho(Ls, p, float(alpha), overlap="1step",
+                                wire_dtype=wire_dtype) \
+        if (pipelined or quantizing) \
+        else contraction_rho(Ls, p, float(alpha))
     return ConsensusSim(log_errors=log_errors, rho_bound=float(rho),
                         alpha=float(alpha))
 
@@ -242,6 +308,114 @@ def degraded_contraction_rho(
     if Ls.shape[-1] < 2:
         return 1.0  # zero or one survivor: no consensus process to bound
     return float(contraction_rho(Ls, p, float(alpha)))
+
+
+def stale_contraction_rho(
+    laplacians: np.ndarray,
+    probs: np.ndarray,
+    alpha: float,
+    overlap: str = "1step",
+    wire_dtype=None,
+) -> float:
+    """Contraction bound for the *pipelined* (one-step-stale) schedule with
+    an optionally narrowed wire.
+
+    Two effects, treated separately because they are separate:
+
+    * **Staleness** (``overlap="1step"``): the pipelined step issues the
+      exchange on the post-apply state ``x_t`` and applies it to
+      ``x_t + u_{t+1}`` — so on the *consensus component* the realized
+      product is exactly the eager W-chain, shifted by one step (proved
+      constructively by ``Communicator.run_overlapped``'s drain
+      equivalence).  The homogeneous contraction factor is therefore
+      **unchanged**; what staleness costs is one extra round on the
+      gradient-injection term (each update joins consensus one W late) —
+      a constant-offset delay of the decay curve, not a rate change.  This
+      is MATCHA's own staleness argument (arXiv:1905.09435): delayed mixing
+      perturbs the constants, not the convergence structure.
+
+    * **Wire quantization** (``wire_dtype="bf16"``): the exchanged values
+      are rounded, so the realized delta is ``(1+η)·Δ`` with
+      ``|η| ≤ ε = 2⁻⁸`` per value.  Worst case over the consensus norm:
+      ``‖W̃x − x̄‖ ≤ ‖Wx − x̄‖ + ε‖Δ‖`` and ``‖Δ‖ = ‖Wx − x‖ ≤
+      (1 + √ρ)·‖x − x̄‖``, giving the adjusted one-step bound
+
+          √ρ_eff = √ρ + ε·(1 + √ρ)   ⇒   ρ_eff = (√ρ + ε(1+√ρ))².
+
+    Consistency: ``overlap="off"`` (or any value) with f32 wire returns
+    exactly ``contraction_rho`` — the base bound; bf16 inflates it by
+    ~2ε·√ρ(1+√ρ), a fraction of a percent at typical ρ.  Like the base
+    bound, the result bounds the MC simulator's empirical rate from above
+    (``tests/test_overlap.py`` pins predictor ≥ measured zoo-wide, the
+    same invariant as the eager MC≤ρ test).
+
+    **Validity floor.**  The multiplicative model prices the wire error
+    relative to the exchanged *delta* — valid while worker disagreement
+    dominates the quantization granularity.  The executor, however,
+    quantizes the full parameter state (``parallel.gossip``: the exchanged
+    operand is ``x̃``, mean component included), so once disagreement
+    shrinks to the bf16 ulp of the *parameter scale* the exchanged
+    differences ``x̃_j − x̃_i`` lose resolution: nearby values collapse to
+    the same (or adjacent) bf16 codes and contraction stalls at an absolute
+    floor of order ``2ε·RMS(x)`` (:func:`wire_disagreement_floor`) instead
+    of continuing geometrically.  ρ_eff is therefore a rate claim *above*
+    the floor; ``steps_to_consensus(ρ_eff, target)`` for targets below
+    ``(floor/e₀)²`` is not achievable under a bf16 wire.  The MC simulator
+    cannot exhibit the floor by construction (it tracks a mean-free,
+    renormalized state, where quantization error is proportional to
+    consensus error); ``tests/test_overlap.py::test_bf16_wire_has_
+    consensus_floor`` pins it against the real executor instead.
+    """
+    if overlap not in ("off", "1step"):
+        raise ValueError(f"overlap must be 'off' or '1step', got {overlap!r}")
+    Ls = np.asarray(laplacians, np.float64)
+    if Ls.shape[-1] < 2:
+        return 1.0  # zero/one survivor (fully-degraded input): no process
+    rho = float(contraction_rho(Ls, np.asarray(probs, np.float64),
+                                float(alpha)))
+    eps = wire_quantization_eps(wire_dtype)
+    if eps == 0.0:
+        return rho
+    root = math.sqrt(max(rho, 0.0))
+    return (root + eps * (1.0 + root)) ** 2
+
+
+def wire_disagreement_floor(wire_dtype, param_scale: float = 1.0) -> float:
+    """Absolute consensus floor of a quantizing wire: ~``2ε·param_scale``.
+
+    ``param_scale`` is the RMS magnitude of the exchanged parameters (mean
+    component included — that is what the executor quantizes).  Below this
+    RMS disagreement the wire's value resolution is exhausted: neighboring
+    workers' values map to the same or adjacent bf16 codes, deltas are
+    either exactly zero (contraction stalls) or one-ulp jumps (granularity
+    noise), and the multiplicative ``stale_contraction_rho`` model no
+    longer describes the dynamics.  0 for f32 wire — the exact program has
+    no such floor above f32's own 2⁻²⁴.
+    """
+    return 2.0 * wire_quantization_eps(wire_dtype) * float(param_scale)
+
+
+def _wire_quantize(x: np.ndarray, wire_dtype) -> np.ndarray:
+    """Round a trajectory state through the wire dtype (numpy side).
+
+    Mirrors the executor's boundary cast (``parallel.gossip``): the values
+    the exchange reads are bf16-rounded; the arithmetic on them stays wide.
+    Uses ``ml_dtypes`` (a jax dependency) for a true round-to-nearest-even
+    bf16, falling back to truncation if unavailable — truncation's error is
+    ≤ 2ε, still inside the predictor's per-step budget at the tolerances
+    the tests use.
+    """
+    if wire_dtype in (None, "f32", "float32"):
+        return x
+    try:
+        import ml_dtypes
+
+        return x.astype(np.float32).astype(ml_dtypes.bfloat16) \
+                .astype(np.float64)
+    except ImportError:  # truncate the f32 mantissa to bf16's 7 bits
+        as_int = x.astype(np.float32).view(np.uint32)
+        return ((as_int + 0x8000) & 0xFFFF0000).view(np.float32) \
+            .astype(np.float64)
 
 
 def steps_to_consensus(rho: float, target: float = 1e-3) -> float:

@@ -26,8 +26,8 @@ import jax.numpy as jnp
 from ..parallel import masked_mean_rows
 
 __all__ = ["finite_rows", "inject_nan_rows", "heal_and_mask",
-           "gossip_quarantined", "heal_worker_stat_rows", "mask_worker_rows",
-           "state_finite_rows"]
+           "gossip_quarantined", "begin_mix_quarantined",
+           "heal_worker_stat_rows", "mask_worker_rows", "state_finite_rows"]
 
 
 def finite_rows(flat: jax.Array) -> jax.Array:
@@ -107,6 +107,24 @@ def gossip_quarantined(step_fn, flat: jax.Array, carry: Any,
     safe = jnp.where(g > 0, flat, jnp.zeros_like(flat))
     mixed, carry = step_fn(safe, carry, flags_t, ok)
     return jnp.where(g > 0, mixed, flat), carry
+
+
+def begin_mix_quarantined(begin_fn, flat: jax.Array, carry: Any,
+                          flags_t: jax.Array, ok: jax.Array,
+                          gate: jax.Array | None = None):
+    """Two-phase twin of :func:`gossip_quarantined` for the overlapped
+    pipeline: issue the exchange with non-finite rows sealed, and zero those
+    rows' *deltas* so the deferred ``apply_mix`` can never write into a
+    quarantined row (the seal on the input already guarantees they
+    contribute nothing to anyone else's delta — their edges are weight-zero
+    via ``ok`` and their values are zeros).  The poison itself stays in
+    ``flat``, visible to the divergence detector."""
+    if gate is None:
+        gate = finite_rows(flat)
+    g = gate.reshape((gate.shape[0],) + (1,) * (flat.ndim - 1))
+    safe = jnp.where(g > 0, flat, jnp.zeros_like(flat))
+    delta, carry = begin_fn(safe, carry, flags_t, ok)
+    return jnp.where(g > 0, delta, jnp.zeros_like(delta)), carry
 
 
 def mask_worker_rows(tree: Any, keep: jax.Array, num_workers: int) -> Any:

@@ -75,6 +75,18 @@ class TrainConfig:
     gossip_backend: str = "auto"  # fused|dense|gather|skip|shard_map|auto
     gossip_block_d: Optional[int] = None  # fused kernel D-block (None = default)
     gossip_w_window: int = 1  # fused kernel W_t per D-block visit (exact)
+    # overlapped gossip pipeline (DESIGN.md §11): "1step" issues each step's
+    # exchange via begin_mix and consumes it at the next step, so XLA can
+    # hide ICI traffic under the next forward/backward; "off" is the eager
+    # schedule (mixing on the critical path).  One-step-stale semantics: the
+    # gradient update joins consensus one round late — contraction effect
+    # predicted by `plan_tpu.py rho --overlap 1step`.
+    overlap: str = "off"  # off|1step
+    # dtype of the exchanged tensors at the gossip boundary: "bf16" halves
+    # bytes_per_step on every backend (ppermute blocks, gathered rows, the
+    # MXU operand pass) while master params and accumulation stay f32;
+    # "f32" compiles the exact legacy program
+    wire_dtype: str = "f32"  # f32|bf16
 
     # logging / checkpointing (reference: --save/--savePath; ckpt is new — §5.4)
     save: bool = False
@@ -141,6 +153,12 @@ class TrainConfig:
                 raise ValueError(
                     f"grad_chunk {self.grad_chunk} must divide "
                     f"num_workers {self.num_workers}")
+        if self.overlap not in ("off", "1step"):
+            raise ValueError(
+                f"overlap must be 'off' or '1step', got {self.overlap!r}")
+        if self.wire_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"wire_dtype must be 'f32' or 'bf16', got {self.wire_dtype!r}")
         if self.compress_warmup_epochs < 0:
             raise ValueError("compress_warmup_epochs must be >= 0")
         if self.compress_warmup_epochs and self.communicator != "choco":

@@ -184,7 +184,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             ratio=ratio, consensus_lr=config.consensus_lr,
             backend=config.gossip_backend, compressor=config.compressor,
             seed=config.seed, block_d=config.gossip_block_d,
-            w_window=config.gossip_w_window,
+            w_window=config.gossip_w_window, wire_dtype=config.wire_dtype,
         )
 
     communicator = _make_comm(config.compress_ratio)
@@ -211,7 +211,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     input_shape = dataset.x_train.shape[1:]
     state, flattener = init_train_state(
         model, input_shape, config.num_workers, optimizer, communicator,
-        seed=config.seed,
+        seed=config.seed, overlap=config.overlap,
     )
     if mesh is not None:
         state = shard_workers(state, mesh)
@@ -224,6 +224,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             model, optimizer, comm, flattener, run_flags,
             dropout=False, lr_schedule=lr_schedule,
             grad_chunk=config.grad_chunk, faults=faults,
+            overlap=config.overlap,
         )
 
     step_fn = None  # populated by _build_programs() below
@@ -289,9 +290,23 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if resume_dir is None:
         resume_dir = config.resume
     if resume_dir is not None:
-        state, last_epoch = restore_checkpoint(resume_dir, state,
-                                               schedule=schedule)
+        # --overlap may differ from the run that wrote the checkpoint, and
+        # orbax restores whatever mix_pending the *checkpoint* holds only if
+        # the template has an array slot for it (a () template silently
+        # drops a saved delta — verified against orbax directly).  Restore
+        # through an always-array probe template: a 1step checkpoint's
+        # in-flight delta comes back as the array, an eager checkpoint's ()
+        # comes back as () — then reconcile with this run's overlap mode.
+        pend0 = jnp.zeros((config.num_workers, flattener.dim), jnp.float32)
+        if mesh is not None:
+            pend0 = shard_workers(pend0, mesh)  # match the state's sharding
+        state, last_epoch = restore_checkpoint(
+            resume_dir, state.replace(mix_pending=pend0), schedule=schedule)
         start_epoch = last_epoch + 1
+        state = _reconcile_mix_pending(state, config.overlap, communicator,
+                                       flattener, config.num_workers)
+        if mesh is not None:  # reconcile may have created fresh zero rows
+            state = shard_workers(state, mesh)
 
     evaluate = make_eval_fn(model)
     recorder = Recorder(config, config.num_workers)
@@ -519,9 +534,48 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                             epoch, schedule=schedule0)
         epoch += 1
 
+    if config.overlap == "1step":
+        # drain the pipeline: apply the final in-flight delta so the
+        # returned parameters are the fully-mixed state — after this, the
+        # pipelined chain has realized exactly the same W-product as the
+        # eager schedule would have (base.py: run_overlapped).  Inside the
+        # run the pending delta stays in TrainState (checkpoints resume the
+        # pipeline without a re-prime); only the result handed back drains.
+        @jax.jit
+        def _drain(s):
+            flat = communicator.apply_mix(
+                flattener.flatten(s.params), s.mix_pending)
+            return s.replace(params=flattener.unflatten(flat),
+                             mix_pending=jnp.zeros_like(s.mix_pending))
+
+        state = _drain(state)
     if config.save:
         recorder.save()
     return TrainResult(state, recorder, schedule, history)
+
+
+def _reconcile_mix_pending(state, overlap: str, communicator, flattener,
+                           num_workers: int):
+    """Align a restored state's in-flight mix delta with this run's
+    ``--overlap`` mode.
+
+    An eager checkpoint carries no delta (``()``): resuming pipelined
+    primes the zero delta the first step consumes; resuming eagerly keeps
+    the empty slot.  A pipelined checkpoint carries a real ``[N, D]``
+    delta: resuming pipelined keeps it (the pipeline continues seamlessly);
+    resuming eagerly *drains* it into the parameters — silently dropping it
+    would lose the final issued mixing step.
+    """
+    pend = state.mix_pending
+    if not hasattr(pend, "shape"):
+        return state.replace(
+            mix_pending=jnp.zeros((num_workers, flattener.dim), jnp.float32)
+            if overlap == "1step" else ())
+    if overlap == "1step":
+        return state
+    flat = communicator.apply_mix(flattener.flatten(state.params),
+                                  jnp.asarray(pend))
+    return state.replace(params=flattener.unflatten(flat), mix_pending=())
 
 
 def _make_comm_timer(communicator, flattener, sample_steps: int = 32):

@@ -42,6 +42,12 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     comm_carry: Any
     step: jax.Array  # scalar int32 — also the schedule cursor (ckpt-critical)
+    # in-flight mixing delta of the overlapped pipeline (DESIGN.md §11):
+    # f32[N, D] when overlap is on (the exchange issued at step t−1, consumed
+    # at step t), the empty tuple when off — the eager path's pytree and
+    # checkpoints are unchanged.  Part of the state on purpose: the pipeline
+    # survives epoch boundaries and checkpoint/resume without a re-prime.
+    mix_pending: Any = ()
 
 
 def make_optimizer(
@@ -66,9 +72,14 @@ def init_train_state(
     communicator: Communicator,
     seed: int = 0,
     sync_init: bool = True,
+    overlap: str = "off",
 ) -> tuple[TrainState, WorkerFlattener]:
     """Per-worker independent inits (torch per-rank ``seed+rank``,
-    train_mpi.py:61) followed by the reference's initial AllReduce sync."""
+    train_mpi.py:61) followed by the reference's initial AllReduce sync.
+
+    ``overlap="1step"`` primes ``mix_pending`` with the zero delta the
+    pipelined step consumes at step 0; ``"off"`` leaves it the empty tuple
+    so the eager state pytree (and its checkpoints) are unchanged."""
     dummy = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
 
     def init_one(key):
@@ -89,6 +100,8 @@ def init_train_state(
         opt_state=optimizer.init(params),
         comm_carry=communicator.init(flattener.flatten(params)),
         step=jnp.zeros((), jnp.int32),
+        mix_pending=(jnp.zeros((num_workers, flattener.dim), jnp.float32)
+                     if overlap == "1step" else ()),
     )
     return state, flattener
 
@@ -103,6 +116,7 @@ def make_train_step(
     lr_schedule: Optional[Callable] = None,
     grad_chunk: Optional[int] = None,
     faults=None,
+    overlap: str = "off",
 ):
     """Build ``step(state, xb, yb[, rng]) -> (state, metrics)``.
 
@@ -133,9 +147,26 @@ def make_train_step(
     ``c < N`` computes gradients in N/c sequential ``lax.map`` slabs instead;
     workers are independent until the consensus transform, so the result is
     identical (tested) — it only caps the live activation set at c·B images.
+
+    ``overlap`` (``"off"``/``"1step"``): the software-pipelined schedule
+    (DESIGN.md §11).  At ``"1step"`` each step first *consumes* the mixing
+    delta issued at step t−1 (``state.mix_pending``, a pure add), then
+    *issues* this step's exchange via ``communicator.begin_mix`` and parks
+    the result for step t+1.  The collective then has no consumer inside the
+    next step's forward/backward, so XLA can overlap ICI traffic with
+    compute.  Semantics: the post-SGD params at step t are mixed by ``W_t``
+    exactly as eagerly — only the *gradient update* of step t+1 joins the
+    consensus one round late (the one-step-stale scheme of
+    arXiv:1905.09435's analysis; contraction-factor effect modeled in
+    ``plan.spectral.stale_contraction_rho``).  The worker mean is untouched:
+    every delta has zero column-mean.  Requires ``state.mix_pending`` to be
+    a ``zeros([N, D])`` (``train/loop.py`` primes it).
     """
     flags_arr = jnp.asarray(np.asarray(flags), jnp.float32)  # [T, M]
     n_workers = flattener.num_workers
+    if overlap not in ("off", "1step"):
+        raise ValueError(f"overlap must be 'off' or '1step', got {overlap!r}")
+    overlap_on = overlap == "1step"
     if faults is not None:
         if faults.alive.shape != (flags_arr.shape[0], n_workers):
             raise ValueError(
@@ -195,9 +226,11 @@ def make_train_step(
         flat = flattener.flatten(params)
         t = jnp.minimum(state.step, flags_arr.shape[0] - 1)
         comm_carry = state.comm_carry
+        mix_pending = state.mix_pending
         alive = None
         if faults is not None:
             from ..resilience.runtime import (
+                begin_mix_quarantined,
                 gossip_quarantined,
                 heal_and_mask,
                 heal_worker_stat_rows,
@@ -211,12 +244,30 @@ def make_train_step(
             keep = 1.0 - healed
             opt_state = mask_worker_rows(opt_state, keep, n)
             comm_carry = mask_worker_rows(comm_carry, keep, n)
+            if overlap_on:
+                # a healed worker restarts from the survivors' average: the
+                # delta issued from its pre-heal parameters is stale
+                # algorithm state like momentum, and is dropped with it
+                mix_pending = mask_worker_rows(mix_pending, keep, n)
             # BN running stats can be neither kept (poisoned/stale) nor
             # zero-reset (variance 0 is not neutral): the healed worker
             # adopts the donors' statistics along with their parameters
             new_stats = heal_worker_stat_rows(new_stats, healed,
                                               alive * keep, n)
-        if alive is None:
+        if overlap_on:
+            # pipelined: consume the exchange issued at step t−1 (a pure
+            # add — zero delta at step 0), then issue this step's exchange;
+            # its collectives have no consumer until step t+1's apply, so
+            # they are free to run under the next forward/backward
+            flat = communicator.apply_mix(flat, mix_pending)
+            if alive is None:
+                mix_pending, carry = communicator.begin_mix(
+                    flat, comm_carry, flags_arr[t])
+            else:
+                mix_pending, carry = begin_mix_quarantined(
+                    communicator.begin_mix, flat, comm_carry, flags_arr[t],
+                    alive, gate=row_finite)
+        elif alive is None:
             flat, carry = communicator.step(flat, comm_carry, flags_arr[t])
         else:
             flat, carry = gossip_quarantined(
@@ -267,6 +318,7 @@ def make_train_step(
                 batch_stats=new_stats,
                 opt_state=opt_state,
                 comm_carry=carry,
+                mix_pending=mix_pending if overlap_on else state.mix_pending,
                 step=state.step + 1,
             ),
             metrics,

@@ -57,8 +57,11 @@ from matcha_tpu.plan import (
     resolve_topology,
     save_plan,
     simulate_consensus,
+    stale_contraction_rho,
     sweep,
     verify_plan_run,
+    wire_disagreement_floor,
+    wire_quantization_eps,
 )
 
 
@@ -72,6 +75,16 @@ def _add_topology_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--seed", type=int, default=9001,
                    help="graph-generation and flag-stream seed "
                         "(train_tpu.py --randomSeed equivalent)")
+
+
+def _add_overlap_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument("--overlap", default="off", choices=["off", "1step"],
+                    help="predict for the pipelined (one-step-stale) "
+                         "schedule train_tpu.py --overlap runs")
+    sp.add_argument("--wire-dtype", default="f32", choices=["f32", "bf16"],
+                    dest="wire_dtype",
+                    help="model the narrowed gossip wire as bounded "
+                         "per-step noise (bf16: eps = 2^-8)")
 
 
 def _topology_specs(args) -> list:
@@ -144,6 +157,42 @@ def cmd_rho(args) -> int:
                 matching_laplacians(decomposed, size),
                 np.asarray(cand["probs"]), cand["alpha"],
                 worker_alive=alive, link_up=1.0 - args.link_drop),
+        }
+    if args.overlap != "off" or args.wire_dtype != "f32":
+        # pipelined-schedule view (DESIGN.md §11): the staleness-adjusted ρ
+        # for --overlap 1step (+ bf16 wire noise).  When the degraded-fleet
+        # flags are also given, the wire adjustment is applied ON TOP of
+        # the degraded mixing (masked Laplacians + effective probs) — the
+        # two views compose into the one ρ the faulty pipelined bf16 run
+        # actually has, instead of two numbers that are each missing half
+        # the story.
+        import numpy as np
+
+        from matcha_tpu.plan import degraded_solver_inputs
+        from matcha_tpu.topology import matching_laplacians
+
+        stale_Ls, stale_p = degraded_solver_inputs(
+            matching_laplacians(decomposed, size),
+            np.asarray(cand["probs"]),
+            worker_alive=alive if alive_vals is not None else None,
+            link_up=(1.0 - args.link_drop) if args.link_drop else None,
+        ) if (alive_vals is not None or args.link_drop) else (
+            matching_laplacians(decomposed, size), np.asarray(cand["probs"]))
+        cand["stale"] = {
+            "overlap": args.overlap,
+            "wire_dtype": args.wire_dtype,
+            "wire_eps": wire_quantization_eps(args.wire_dtype),
+            "composed_with_degraded": bool(alive_vals is not None
+                                           or args.link_drop),
+            "rho": stale_contraction_rho(
+                stale_Ls, stale_p, cand["alpha"],
+                overlap=args.overlap, wire_dtype=args.wire_dtype),
+            # the rate claim is valid only above this RMS disagreement
+            # (relative to parameter RMS): below it the bf16 wire's value
+            # resolution is exhausted and contraction stalls — consensus
+            # targets under (floor/e0)^2 are unreachable at this wire
+            "disagreement_floor_rel": wire_disagreement_floor(
+                args.wire_dtype),
         }
     print(json.dumps(cand, indent=1))
     return 0
@@ -218,9 +267,11 @@ def cmd_simulate(args) -> int:
     alpha, rho = solve_mixing_weight(Ls, probs)
     sim = simulate_consensus(decomposed, size, probs, alpha,
                              steps=args.mc_steps, trials=args.mc_trials,
-                             seed=args.seed, laplacians=Ls)
+                             seed=args.seed, laplacians=Ls,
+                             overlap=args.overlap, wire_dtype=args.wire_dtype)
     print(json.dumps({
         **norm, "budget": args.budget, "alpha": alpha,
+        "overlap": args.overlap, "wire_dtype": args.wire_dtype,
         "rho_bound": sim.rho_bound,
         "mc_empirical_rate": sim.empirical_rate(),
         "mean_decay_curve": [float(v) for v in sim.mean_decay_curve()],
@@ -263,11 +314,13 @@ def main(argv=None) -> int:
                     help="i.i.d. link drop probability for the degraded-rho "
                          "view (matches schedule.with_link_failures / a "
                          "flaky_link fault event)")
+    _add_overlap_args(sp)
     sp.set_defaults(fn=cmd_rho)
 
     sp = sub.add_parser("simulate", help="Monte-Carlo consensus trajectory")
     add_common(sp, mc_default=8)
     sp.add_argument("--budget", type=float, default=0.5)
+    _add_overlap_args(sp)
     sp.set_defaults(fn=cmd_simulate)
 
     sp = sub.add_parser("cost", help="per-matching hop-cost ledger")

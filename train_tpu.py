@@ -96,6 +96,18 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--w-window", type=int, default=1, dest="w_window",
                    help="fused-backend W_t steps per D-block VMEM visit "
                         "(exact per-step arithmetic, amortizes grid overhead)")
+    p.add_argument("--overlap", default="off", choices=["off", "1step"],
+                   help="software-pipelined gossip: '1step' issues each "
+                        "step's exchange (begin_mix) and consumes it at the "
+                        "next step, so XLA overlaps ICI traffic with the "
+                        "next fwd/bwd; one-step-stale semantics — see "
+                        "plan_tpu.py rho --overlap for the predicted "
+                        "contraction effect")
+    p.add_argument("--wire-dtype", default="f32", choices=["f32", "bf16"],
+                   dest="wire_dtype",
+                   help="dtype of the exchanged tensors at the gossip "
+                        "boundary: bf16 halves bytes/step on every backend "
+                        "(master params stay f32)")
     p.add_argument("--fixed-mode", default="all", dest="fixed_mode",
                    help="D-PSGD flag mode: all|bernoulli|alternating "
                         "(alternating = reference ring parity, SURVEY Q1)")
@@ -163,7 +175,8 @@ def parse_args(argv=None) -> TrainConfig:
         consensus_lr=args.consensus_lr,
         compress_warmup_epochs=args.compress_warmup_epochs,
         gossip_backend=args.backend, gossip_block_d=args.block_d,
-        gossip_w_window=args.w_window, save=args.save, savePath=args.savePath,
+        gossip_w_window=args.w_window, overlap=args.overlap,
+        wire_dtype=args.wire_dtype, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         fault_plan=args.fault_plan, max_recoveries=args.max_recoveries,
         recovery_lr_backoff=args.recovery_lr_backoff,
