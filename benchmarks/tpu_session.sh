@@ -18,6 +18,14 @@ set -x
 #    provisional); the gate only gates the *expensive tuning* steps below.
 timeout -k 30 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; GATE_RC=$?
 
+# 0.1 clean-lint stamp: record that the tree this session measured passes
+#     graftlint (static invariants + empty baseline) next to the bench
+#     captures — a bench number from a tree that violates the wire-seam or
+#     masking invariants is not evidence.  Pure host work, tunnel-immune;
+#     the stamp carries clean=true/false either way.
+timeout -k 10 120 python lint_tpu.py --format json > benchmarks/lint_stamp_r6.json \
+    || echo "lint stamp: violations recorded in benchmarks/lint_stamp_r6.json"
+
 # 1. THE driver artifact: per-step primary + chunked secondary + the
 #    overlap × wire-dtype grid (bench.py now emits `overlap_grid` by
 #    default: eager|1step × f32|bf16 cells with rate + bytes_per_step);

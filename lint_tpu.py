@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""graftlint CLI — run the repo's static-analysis rules over the tree.
+
+The rules (GL001–GL006, ``matcha_tpu/analysis/rules.py``) encode the
+invariants the MATCHA-class guarantees hang on: where-not-multiply NaN
+masking, host purity of compiled code, the shared collective axis constant,
+the single wire_dtype seam, the two-phase communicator contract, loud
+failure paths.  ``tests/test_analysis.py`` runs the same engine in tier-1;
+this CLI is the interactive/CI surface.
+
+Examples
+--------
+Lint the shipped surface (the tier-1 contract)::
+
+    python lint_tpu.py
+
+JSON artifact for a live session (benchmarks/tpu_session.sh records one)::
+
+    python lint_tpu.py --format json > benchmarks/lint_stamp.json
+
+Grandfather the current violations (new ones still fail)::
+
+    python lint_tpu.py --write-baseline
+
+Exit code 0 = clean (modulo baseline), 1 = violations, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from matcha_tpu.analysis import (
+    lint_paths,
+    load_baseline,
+    render_json,
+    render_text,
+    rules_by_id,
+    write_baseline,
+)
+
+# the shipped lint surface: the package and every executable entry point.
+# tests/ is deliberately excluded — fixtures *construct* violations.
+DEFAULT_PATHS = ["matcha_tpu", "train_tpu.py", "plan_tpu.py", "bench.py"]
+DEFAULT_BASELINE = "graftlint_baseline.json"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", default=None,
+                   help=f"files/packages to lint (default: {DEFAULT_PATHS})")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file of grandfathered violations "
+                        "(missing file = empty baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every violation")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record current violations into --baseline and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every rule id, title, and invariant")
+    args = p.parse_args(argv)
+
+    try:
+        rules = rules_by_id(args.rules.split(",") if args.rules else None)
+    except KeyError as e:
+        print(f"lint_tpu: {e}", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.title}")
+            print(f"       {r.invariant}\n")
+        return 0
+
+    baseline = set() if (args.no_baseline or args.write_baseline) \
+        else load_baseline(args.baseline)
+    try:
+        violations, sources = lint_paths(args.paths or DEFAULT_PATHS, rules,
+                                         baseline=baseline)
+    except FileNotFoundError as e:
+        print(f"lint_tpu: no such file: {e.filename}", file=sys.stderr)
+        return 2
+    except SyntaxError as e:
+        print(f"lint_tpu: cannot parse {e.filename}:{e.lineno}: {e.msg}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline, violations)
+        print(f"lint_tpu: wrote {len(violations)} grandfathered "
+              f"violation(s) to {args.baseline}")
+        return 0
+
+    render = render_json if args.format == "json" else render_text
+    print(render(violations, sources, rules))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""graftlint: repo-native static analysis + trace-purity sanitizer.
+
+Machine-checks the invariants earlier PRs established only as review lore:
+
+* ``engine``    — violations, inline suppressions, baseline, reporting
+* ``rules``     — GL001–GL006, the repo-specific AST checks
+* ``sanitizer`` — the dynamic retrace (recompilation) detector
+
+CLI: ``python lint_tpu.py [paths...]``; enforced in tier-1 by
+``tests/test_analysis.py`` (marker: ``analysis``).  Deliberately free of
+jax imports at module scope — the linter must run (and fail fast) even on a
+host whose accelerator backend is wedged.
+"""
+
+from .engine import (
+    LintSource,
+    Violation,
+    collect_sources,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    render_json,
+    render_text,
+    write_baseline,
+)
+from .rules import ALL_RULES, Rule, rules_by_id
+from .sanitizer import TraceCount, check_single_trace, retrace_guard
+
+__all__ = [
+    "ALL_RULES",
+    "LintSource",
+    "Rule",
+    "TraceCount",
+    "Violation",
+    "check_single_trace",
+    "collect_sources",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "render_json",
+    "render_text",
+    "retrace_guard",
+    "rules_by_id",
+    "write_baseline",
+]

@@ -1,0 +1,255 @@
+"""graftlint — repo-native static analysis engine.
+
+Three PRs of growth produced correctness invariants that existed only as
+review lore: NaN masking must be ``jnp.where`` (0·NaN leaks, the PR 3 bug
+class), every exchange must ride the single ``wire_dtype`` seam, collectives
+must use the shared mesh axis constant, nothing host-impure may reach the
+compiled step.  The MATCHA-class guarantee — realized mixing stays doubly
+stochastic and contraction matches the planner's ρ — silently breaks when
+any one convention is violated, so this module machine-checks them on every
+test run, the way ``tests/test_docs_artifacts.py`` machine-checks doc claims.
+
+This file is the *engine*: source loading, inline suppressions, the
+committed baseline, text/JSON reporting.  The repo-specific rules live in
+``rules.py``; the dynamic retrace sanitizer in ``sanitizer.py``.
+
+Suppression syntax
+------------------
+A violation is silenced by an inline comment on the reported line, or on a
+standalone comment line directly above it::
+
+    delta = _rows(alive * alive[pi], delta) * delta  # graftlint: disable=GL001 — weights, not values
+
+    # graftlint: disable=GL002 — host-side logging, never traced
+    print(status)
+
+Multiple ids separate with commas (``disable=GL001,GL004``).  Everything
+after the id list is a free-form reason — *write one*: the suppression is a
+claim that the invariant holds for a reason the rule cannot see, and the
+reason is what the next reader audits.
+
+Baseline workflow
+-----------------
+``lint_tpu.py --write-baseline`` records the current violation set into
+``graftlint_baseline.json``; subsequent runs fail only on *new* violations.
+The shipped baseline is empty — every grandfathered site was either fixed or
+given an inline suppression with a reason (ISSUE 5 satellite audit) — and
+``tests/test_analysis.py`` keeps it that way.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+__all__ = [
+    "LintSource",
+    "Violation",
+    "collect_sources",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "render_text",
+    "render_json",
+    "write_baseline",
+]
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule hit, anchored to a file:line (the node's start line)."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, str, int]:
+        """Baseline identity: rule + file + line (columns drift too easily)."""
+        return (self.rule, self.path, self.line)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintSource:
+    """A parsed file plus its per-line suppression table."""
+
+    path: str  # repo-relative
+    text: str
+    tree: ast.AST
+    lines: List[str]
+    suppressions: Dict[int, Set[str]]  # line -> rule ids silenced there
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        return rule_id in self.suppressions.get(line, set())
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """Per-line suppression table.
+
+    A ``# graftlint: disable=...`` comment silences its own line; when the
+    line holds nothing but the comment, it silences the next *code* line
+    instead (the standalone-annotation form used above multi-line
+    statements — continuation comment lines in between are skipped).
+    """
+    table: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+        target = lineno
+        if line.lstrip().startswith("#"):  # standalone: walk to the code line
+            target = lineno + 1
+            while target <= len(lines) and (
+                    not lines[target - 1].strip()
+                    or lines[target - 1].lstrip().startswith("#")):
+                target += 1
+        table.setdefault(target, set()).update(ids)
+    return table
+
+
+def load_source(path: pathlib.Path, repo_root: pathlib.Path) -> LintSource:
+    text = path.read_text()
+    try:
+        rel = str(path.resolve().relative_to(repo_root.resolve()))
+    except ValueError:  # outside the root (tmp fixtures in tests)
+        rel = str(path)
+    rel = rel.replace("\\", "/")
+    lines = text.splitlines()
+    return LintSource(
+        path=rel,
+        text=text,
+        tree=ast.parse(text, filename=rel),
+        lines=lines,
+        suppressions=_parse_suppressions(lines),
+    )
+
+
+def collect_sources(paths: Sequence[str | pathlib.Path],
+                    repo_root: str | pathlib.Path | None = None,
+                    ) -> List[LintSource]:
+    """Expand files/packages into parsed :class:`LintSource` objects.
+
+    Directories recurse over ``*.py``; ``__pycache__`` is skipped.  Paths are
+    reported repo-relative so baselines and suppressions survive checkouts at
+    different absolute locations.
+    """
+    root = pathlib.Path(repo_root) if repo_root is not None \
+        else pathlib.Path(__file__).resolve().parents[2]
+    out: List[LintSource] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            files = sorted(f for f in p.rglob("*.py")
+                           if "__pycache__" not in f.parts)
+        else:
+            files = [p]
+        for f in files:
+            out.append(load_source(f, root))
+    return out
+
+
+def lint_source(source: LintSource, rules: Sequence) -> List[Violation]:
+    """Run ``rules`` over one file; suppressed hits are dropped here, and
+    duplicate (rule, line) reports (e.g. nested multiplies inside one
+    expression) collapse to the first."""
+    seen: Set[Tuple[str, str, int]] = set()
+    out: List[Violation] = []
+    for rule in rules:
+        for v in rule.check(source):
+            if v.key() in seen:
+                continue
+            seen.add(v.key())
+            if source.suppressed(v.rule, v.line):
+                continue
+            out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def lint_paths(paths: Sequence[str | pathlib.Path], rules: Sequence,
+               baseline: Set[Tuple[str, str, int]] | None = None,
+               repo_root: str | pathlib.Path | None = None,
+               ) -> Tuple[List[Violation], List[LintSource]]:
+    """Lint every file under ``paths``; returns (non-baselined violations,
+    the sources scanned)."""
+    sources = collect_sources(paths, repo_root=repo_root)
+    violations: List[Violation] = []
+    for src in sources:
+        for v in lint_source(src, rules):
+            if baseline and v.key() in baseline:
+                continue
+            violations.append(v)
+    return violations, sources
+
+
+# --------------------------------------------------------------- baseline IO
+
+def load_baseline(path: str | pathlib.Path) -> Set[Tuple[str, str, int]]:
+    """Grandfathered violation keys; a missing file is an empty baseline."""
+    p = pathlib.Path(path)
+    if not p.exists():
+        return set()
+    data = json.loads(p.read_text())
+    return {(v["rule"], v["path"], int(v["line"]))
+            for v in data.get("violations", [])}
+
+
+def write_baseline(path: str | pathlib.Path,
+                   violations: Iterable[Violation]) -> None:
+    payload = {
+        "comment": "graftlint grandfathered sites — shrink, never grow "
+                   "(see docs/DESIGN.md §12)",
+        "violations": [
+            {"rule": v.rule, "path": v.path, "line": v.line,
+             "message": v.message}
+            for v in sorted(violations, key=lambda v: v.key())
+        ],
+    }
+    pathlib.Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+# --------------------------------------------------------------- reporting
+
+def render_text(violations: Sequence[Violation], sources: Sequence[LintSource],
+                rules: Sequence) -> str:
+    by_path = {s.path: s for s in sources}
+    lines = []
+    for v in violations:
+        lines.append(f"{v.path}:{v.line}:{v.col}: {v.rule} {v.message}")
+        src = by_path.get(v.path)
+        if src and 0 < v.line <= len(src.lines):
+            lines.append(f"    {src.lines[v.line - 1].strip()}")
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    summary = ", ".join(f"{r}: {n}" for r, n in sorted(counts.items()))
+    lines.append(
+        f"graftlint: {len(violations)} violation(s) in "
+        f"{len(sources)} file(s)" + (f" [{summary}]" if summary else "")
+    )
+    return "\n".join(lines)
+
+
+def render_json(violations: Sequence[Violation], sources: Sequence[LintSource],
+                rules: Sequence) -> str:
+    return json.dumps(
+        {
+            "violations": [v.to_json() for v in violations],
+            "files_checked": len(sources),
+            "rules": [{"id": r.id, "title": r.title} for r in rules],
+            "clean": not violations,
+        },
+        indent=2,
+    )

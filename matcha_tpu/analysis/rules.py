@@ -1,0 +1,564 @@
+"""graftlint rules — the repo's correctness invariants as AST checks.
+
+Each rule encodes an invariant a past PR's bug class motivated (history in
+docs/DESIGN.md §12):
+
+========  ==================================================================
+GL001     mask · value multiplies (0·NaN leaks — use ``jnp.where``)
+GL002     host impurity reachable from jit/shard_map-compiled code
+GL003     string-literal collective axis names (use ``mesh.WORKER_AXIS``)
+GL004     narrow dtype casts outside the ``wire_dtype`` seam
+GL005     one-sided ``begin_mix``/``apply_mix`` overrides (two-phase contract)
+GL006     bare ``except`` / swallowed exceptions
+========  ==================================================================
+
+Rules over-approximate on purpose: a flagged site is either converted to the
+safe form or suppressed inline *with a reason* — the reason is the artifact
+(e.g. ``# graftlint: disable=GL001 — weights, not values``).  The shipped
+tree carries zero baselined violations; ``tests/test_analysis.py`` enforces
+that and exercises every rule on synthetic positives/negatives.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import LintSource, Violation
+
+__all__ = ["ALL_RULES", "Rule", "rules_by_id"]
+
+
+class Rule:
+    """Base: subclasses define ``id``, ``title``, ``invariant`` and
+    ``check(source) -> list[Violation]``."""
+
+    id = "GL000"
+    title = ""
+    invariant = ""
+
+    def check(self, source: LintSource) -> List[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def hit(self, source: LintSource, node: ast.AST, message: str) -> Violation:
+        return Violation(
+            rule=self.id, path=source.path,
+            line=getattr(node, "lineno", 1), col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_values(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into Subscript indices: in
+    ``delta[alive_idx]`` the index is row *selection*, not a factor of the
+    product, so it must not make the expression look mask-scaled."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for field, value in ast.iter_fields(n):
+            if isinstance(n, ast.Subscript) and field == "slice":
+                continue
+            if isinstance(value, ast.AST):
+                stack.append(value)
+            elif isinstance(value, list):
+                stack.extend(v for v in value if isinstance(v, ast.AST))
+
+
+# =========================================================================
+# GL001 — multiply-masking of value arrays
+# =========================================================================
+
+_MASK_SUBSTR = re.compile(
+    r"alive|mask|finite|heal|donor|partner|quarantin", re.IGNORECASE)
+_MASK_EXACT = {"ok", "keep", "kept", "gate"}
+
+
+def _is_mask_id(name: str) -> bool:
+    return name in _MASK_EXACT or bool(_MASK_SUBSTR.search(name))
+
+
+def _mentions_mask(node: ast.AST) -> bool:
+    for n in _walk_values(node):
+        if isinstance(n, ast.Name) and _is_mask_id(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and _is_mask_id(n.attr):
+            return True
+    return False
+
+
+def _mask_simple(node: ast.AST) -> bool:
+    """A *direct* mask expression: a mask-named value possibly broadcast,
+    complemented, cast, or clipped — the shapes mask algebra composes from.
+    ``mask1 * mask_simple`` products are exempt from GL001: masks are 0/1
+    and finite by construction, so multiplying them cannot launder a NaN.
+    """
+    if isinstance(node, ast.UnaryOp):
+        return _mask_simple(node.operand)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+            and isinstance(node.left, ast.Constant):
+        return _mask_simple(node.right)  # complement: 1.0 - mask
+    if isinstance(node, ast.Subscript):
+        return _mask_simple(node.value)
+    if isinstance(node, ast.Call):
+        f = node.func
+        # mask.astype(...) / mask.reshape(...) / jnp.clip(mask, 0, 1)
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("astype", "reshape"):
+                return _mask_simple(f.value)
+            if f.attr == "clip" and node.args:
+                return _mask_simple(node.args[0])
+        return False
+    if isinstance(node, ast.Attribute):
+        return _is_mask_id(node.attr)
+    if isinstance(node, ast.Name):
+        return _is_mask_id(node.id)
+    return False
+
+
+class GL001MultiplyMasking(Rule):
+    id = "GL001"
+    title = "mask multiplied into a value array (use jnp.where)"
+    invariant = (
+        "Quarantine masks gate *value* arrays with jnp.where, never a "
+        "multiply: 0·NaN = NaN, so a multiplicative mask leaks the very "
+        "poison it exists to contain (the PR 3 bug class; see "
+        "parallel/collectives.py masked_mean_rows).  Scaling edge *weights* "
+        "by a mask is legal — the weights are finite schedule constants — "
+        "and must say so: # graftlint: disable=GL001 — weights, not values."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        out = []
+        for node in ast.walk(source.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mult)):
+                continue
+            if not (_mentions_mask(node.left) or _mentions_mask(node.right)):
+                continue
+            if _mask_simple(node.left) and _mask_simple(node.right):
+                continue  # mask ∘ mask algebra: finite by construction
+            out.append(self.hit(
+                source, node,
+                "mask-scaled multiply — if this masks values, 0·NaN leaks: "
+                "use jnp.where(mask > 0, x, ...); if it scales finite "
+                "weights, suppress with a reason",
+            ))
+        return out
+
+
+# =========================================================================
+# GL002 — host impurity reachable from compiled code
+# =========================================================================
+
+_JIT_WRAPPERS = {"jit", "jax.jit", "pjit", "jax.pjit", "pmap", "jax.pmap"}
+_SHARD_MAP = {"shard_map", "jax.shard_map",
+              "jax.experimental.shard_map.shard_map"}
+# transforms whose function arguments execute at trace time inside the
+# enclosing compiled program — reachability flows through them
+_TRANSFORMS = {
+    "jax.vmap", "vmap", "jax.grad", "grad", "jax.value_and_grad",
+    "value_and_grad", "jax.checkpoint", "checkpoint", "jax.remat", "remat",
+    "jax.lax.scan", "lax.scan", "scan", "jax.lax.cond", "lax.cond", "cond",
+    "jax.lax.map", "lax.map", "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.while_loop", "lax.while_loop", "lax.switch", "jax.lax.switch",
+    "functools.partial", "partial",
+}
+_IMPURE_EXACT = {
+    "time.time": "wall-clock freezes to a trace-time constant inside jit",
+    "time.perf_counter": "wall-clock freezes to a trace-time constant",
+    "time.monotonic": "wall-clock freezes to a trace-time constant",
+    "time.process_time": "wall-clock freezes to a trace-time constant",
+    "time.sleep": "host sleep has no effect on the compiled program",
+    "print": "prints once at trace time, never per step — use "
+             "jax.debug.print",
+    "input": "host input cannot run inside a compiled step",
+    "breakpoint": "host breakpoint cannot run inside a compiled step",
+}
+_IMPURE_PREFIX = {
+    "np.random.": "numpy randomness is drawn once at trace time and baked "
+                   "into the program — use jax.random with a threaded key",
+    "numpy.random.": "numpy randomness is drawn once at trace time — use "
+                      "jax.random with a threaded key",
+    "random.": "python randomness is drawn once at trace time — use "
+               "jax.random with a threaded key",
+}
+
+
+def _collect_functions(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> def nodes (module-level and nested alike; lambdas bound by
+    simple assignment count too)."""
+    table: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            table.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Lambda):
+            table.setdefault(node.targets[0].id, []).append(node.value)
+    return table
+
+
+def _collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    """``g = jax.vmap(f)``-style bindings: alias name -> wrapped name."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        fn = _dotted(node.value.func)
+        if fn in _TRANSFORMS | _JIT_WRAPPERS | _SHARD_MAP:
+            for arg in node.value.args:
+                if isinstance(arg, ast.Name):
+                    aliases[node.targets[0].id] = arg.id
+                    break
+    return aliases
+
+
+def _jit_roots(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(label, def-node) pairs entering compilation: @jax.jit decorations,
+    jit(f)/shard_map(f) call arguments (names and lambdas alike)."""
+    roots: List[Tuple[str, ast.AST]] = []
+    table = _collect_functions(tree)
+
+    def _is_jit_decorator(dec: ast.AST) -> bool:
+        name = _dotted(dec)
+        if name in _JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            fn = _dotted(dec.func)
+            if fn in _JIT_WRAPPERS:
+                return True
+            if fn in ("functools.partial", "partial") and dec.args:
+                return _dotted(dec.args[0]) in _JIT_WRAPPERS
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                roots.append((node.name, node))
+        elif isinstance(node, ast.Call):
+            fn = _dotted(node.func)
+            if fn in _JIT_WRAPPERS or fn in _SHARD_MAP \
+                    or (fn is not None and fn.endswith("shard_map")):
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        roots.append((f"<lambda@{arg.lineno}>", arg))
+                    elif isinstance(arg, ast.Name) and arg.id in table:
+                        for defn in table[arg.id]:
+                            roots.append((arg.id, defn))
+                    break  # only the first argument is the traced callable
+    return roots
+
+
+class GL002HostImpurity(Rule):
+    id = "GL002"
+    title = "host-impure call reachable from compiled code"
+    invariant = (
+        "Functions reaching jax.jit / shard_map execute their python bodies "
+        "once, at trace time: time.time() freezes, np.random draws one "
+        "sample forever, print fires once, .item()/int()/float() force a "
+        "device sync or fail on tracers.  Host work belongs outside the "
+        "compiled step; genuinely host-only helpers suppress with a reason."
+    )
+
+    def _impure(self, call: ast.Call) -> Optional[str]:
+        fn = _dotted(call.func)
+        if fn in _IMPURE_EXACT:
+            return f"`{fn}` — {_IMPURE_EXACT[fn]}"
+        if fn is not None:
+            for prefix, why in _IMPURE_PREFIX.items():
+                if fn.startswith(prefix):
+                    return f"`{fn}` — {why}"
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "item" \
+                and not call.args:
+            return "`.item()` — forces a device→host sync; fails on tracers"
+        if isinstance(call.func, ast.Name) and call.func.id in ("int", "float") \
+                and call.args \
+                and not isinstance(call.args[0], ast.Constant):
+            return (f"`{call.func.id}()` on a non-constant — concretizes a "
+                    f"traced value (ConcretizationTypeError under jit)")
+        return None
+
+    def check(self, source: LintSource) -> List[Violation]:
+        table = _collect_functions(source.tree)
+        aliases = _collect_aliases(source.tree)
+        out: List[Violation] = []
+        reported: Set[int] = set()
+        visited: Set[int] = set()
+
+        def resolve(name: str) -> List[ast.AST]:
+            name = aliases.get(name, name)
+            return table.get(name, [])
+
+        def scan(fn_node: ast.AST, root: str) -> None:
+            if id(fn_node) in visited:
+                return
+            visited.add(id(fn_node))
+            for n in ast.walk(fn_node):
+                if not isinstance(n, ast.Call):
+                    continue
+                why = self._impure(n)
+                if why is not None and id(n) not in reported:
+                    reported.add(id(n))
+                    out.append(self.hit(
+                        source, n,
+                        f"{why} [reachable from compiled `{root}`]"))
+                fn = _dotted(n.func)
+                if fn is not None:
+                    # plain local call: f(...)
+                    for defn in resolve(fn):
+                        if defn is not fn_node:
+                            scan(defn, root)
+                    # higher-order transform: vmap(f)(...) etc.
+                    if fn in _TRANSFORMS:
+                        for arg in n.args:
+                            if isinstance(arg, ast.Name):
+                                for defn in resolve(arg.id):
+                                    scan(defn, root)
+                            elif isinstance(arg, ast.Lambda):
+                                scan(arg, root)
+
+        for root_name, root_node in _jit_roots(source.tree):
+            scan(root_node, root_name)
+        return out
+
+
+# =========================================================================
+# GL003 — string-literal collective axis names
+# =========================================================================
+
+_COLLECTIVES = {
+    "ppermute", "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "axis_index", "pshuffle",
+}
+
+
+class GL003LiteralAxisName(Rule):
+    id = "GL003"
+    title = "string-literal collective axis name"
+    invariant = (
+        "Every collective must name the mesh axis through "
+        "parallel.mesh.WORKER_AXIS (or a variable threaded from it): a "
+        "string literal at the call site silently decouples that collective "
+        "from the one axis the folded plans, shard specs, and fault masks "
+        "all agree on — a rename or a second mesh axis then deadlocks or "
+        "mis-routes only the hardcoded site."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        out = []
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _dotted(node.func)
+            if fn is None or fn.split(".")[-1] not in _COLLECTIVES:
+                continue
+            literal = None
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    literal = arg
+                    break
+            if literal is not None:
+                out.append(self.hit(
+                    source, node,
+                    f"`{fn}` called with axis name {literal.value!r} as a "
+                    f"string literal — import WORKER_AXIS from "
+                    f"matcha_tpu.parallel.mesh instead",
+                ))
+        return out
+
+
+# =========================================================================
+# GL004 — narrow dtype casts outside the wire_dtype seam
+# =========================================================================
+
+_NARROW_ATTRS = {
+    "bfloat16", "float16", "half", "int8", "uint8",
+    "float8_e4m3fn", "float8_e5m2", "float8_e4m3", "float8_e5m2fnuz",
+}
+_NARROW_STRINGS = {"bfloat16", "bf16", "float16", "f16", "int8", "uint8"}
+_GL004_SCOPE = ("matcha_tpu/parallel/", "matcha_tpu/communicator/")
+
+
+def _narrow_dtype_arg(arg: ast.AST) -> Optional[str]:
+    if isinstance(arg, ast.Attribute) and arg.attr in _NARROW_ATTRS:
+        return _dotted(arg) or arg.attr
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and arg.value in _NARROW_STRINGS:
+        return repr(arg.value)
+    return None
+
+
+class GL004WireDtypeSeam(Rule):
+    id = "GL004"
+    title = "hard-coded narrow dtype cast outside the wire_dtype seam"
+    invariant = (
+        "Every exchanged tensor narrows through resolve_wire_dtype "
+        "(parallel/gossip.py) — the one seam where quantize-before-exchange "
+        "keeps edge-pairwise cancellation, and with it exact worker-mean "
+        "preservation (PR 4).  A hard-coded .astype(jnp.bfloat16) in the "
+        "exchange layer bypasses the seam: the wire knob stops describing "
+        "what actually crosses the wire and the ρ_eff/floor predictions in "
+        "plan.spectral go quietly wrong."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        if not any(source.path.startswith(s) or f"/{s}" in source.path
+                   for s in _GL004_SCOPE):
+            return []
+        out = []
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            args: List[ast.AST] = []
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype":
+                args = list(node.args)
+            else:
+                fn = _dotted(node.func)
+                if fn is not None and fn.split(".")[-1] in ("asarray", "full",
+                                                            "zeros", "ones"):
+                    args = list(node.args)[1:] + \
+                        [kw.value for kw in node.keywords if kw.arg == "dtype"]
+            for arg in args:
+                narrow = _narrow_dtype_arg(arg)
+                if narrow is not None:
+                    out.append(self.hit(
+                        source, node,
+                        f"cast to {narrow} in the exchange layer bypasses "
+                        f"resolve_wire_dtype — thread wire_dtype through the "
+                        f"seam instead",
+                    ))
+        return out
+
+
+# =========================================================================
+# GL005 — one-sided two-phase overrides
+# =========================================================================
+
+class GL005TwoPhaseContract(Rule):
+    id = "GL005"
+    title = "begin_mix overridden without apply_mix (or vice versa)"
+    invariant = (
+        "The overlapped pipeline (PR 4) splits every communicator into "
+        "issue (begin_mix → delta) and consume (apply_mix).  The two are a "
+        "contract: the delta begin_mix returns is only meaningful to the "
+        "apply_mix that matches it (zero column-mean, one-step-stale "
+        "semantics).  Overriding one side alone ships a communicator whose "
+        "pipelined chain silently diverges from its eager chain."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        out = []
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {_dotted(b) for b in node.bases}
+            if not any(b and b.split(".")[-1] == "Communicator"
+                       for b in bases):
+                continue
+            defined = {
+                n.name for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            has_begin = "begin_mix" in defined
+            has_apply = "apply_mix" in defined
+            if has_begin != has_apply:
+                have, miss = (("begin_mix", "apply_mix") if has_begin
+                              else ("apply_mix", "begin_mix"))
+                out.append(self.hit(
+                    source, node,
+                    f"Communicator subclass `{node.name}` overrides "
+                    f"`{have}` without `{miss}` — the two-phase pair must "
+                    f"move together (DESIGN.md §11)",
+                ))
+        return out
+
+
+# =========================================================================
+# GL006 — bare except / swallowed exceptions
+# =========================================================================
+
+class GL006SwallowedExceptions(Rule):
+    id = "GL006"
+    title = "bare except / silently swallowed exception"
+    invariant = (
+        "The recovery path (train/loop.py rollback, PR 3) works because "
+        "failures surface: the divergence detector raises, the fault ledger "
+        "records, rollback retries.  A bare `except:` also catches "
+        "KeyboardInterrupt/SystemExit; a broad `except Exception: pass` "
+        "turns a real failure into silence the resilience machinery never "
+        "sees.  (Narrow catches with pass/continue are EAFP and stay legal "
+        "— the rule fires on Exception/BaseException breadth only.)  "
+        "Deliberate best-effort swallows must name their reason inline."
+    )
+
+    @staticmethod
+    def _broad(handler_type: ast.AST) -> bool:
+        types = handler_type.elts if isinstance(handler_type, ast.Tuple) \
+            else [handler_type]
+        return any(
+            (_dotted(t) or "").split(".")[-1] in ("Exception", "BaseException")
+            for t in types
+        )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        out = []
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(self.hit(
+                    source, node,
+                    "bare `except:` — also catches KeyboardInterrupt/"
+                    "SystemExit; name the exception type",
+                ))
+                continue
+            if not self._broad(node.type):
+                continue
+            body = [n for n in node.body
+                    if not (isinstance(n, ast.Expr)
+                            and isinstance(n.value, ast.Constant))]
+            if all(isinstance(n, (ast.Pass, ast.Continue)) for n in body):
+                out.append(self.hit(
+                    source, node,
+                    "exception swallowed (`pass`-only handler) — log it, "
+                    "re-raise, or suppress with the reason the swallow is "
+                    "safe",
+                ))
+        return out
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    GL001MultiplyMasking(),
+    GL002HostImpurity(),
+    GL003LiteralAxisName(),
+    GL004WireDtypeSeam(),
+    GL005TwoPhaseContract(),
+    GL006SwallowedExceptions(),
+)
+
+
+def rules_by_id(ids: Optional[Sequence[str]] = None) -> Tuple[Rule, ...]:
+    if not ids:
+        return ALL_RULES
+    wanted = {i.strip().upper() for i in ids}
+    unknown = wanted - {r.id for r in ALL_RULES}
+    if unknown:
+        raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+    return tuple(r for r in ALL_RULES if r.id in wanted)

@@ -1,0 +1,80 @@
+"""Dynamic trace-purity sanitizer: a recompilation detector for the step.
+
+The static rules (GL002) catch host impurity *syntactically*; this module
+catches the runtime symptom the whole compile-time contract exists to
+prevent — **retracing**.  The repo's design premise (DESIGN.md §1) is that
+the entire schedule compiles into one program: flags are trace-time
+constants indexed by ``state.step``, communication patterns are static,
+shapes never change.  If any of that slips — a python scalar that should be
+an array, a shape that depends on the step, a dict key order that flaps —
+XLA silently recompiles every step and the 'compiled' train loop runs at
+trace speed.  Nothing crashes; throughput just quietly dies.
+
+:func:`retrace_guard` wraps an (already-jitted or plain) step function in an
+*outer* ``jax.jit`` whose python body bumps a counter.  The body only runs
+while tracing, and the outer jit's cache key is exactly the (structure,
+shape, dtype) signature of the arguments — so after the first step the
+counter must stay at 1.  A counter > 1 after step 0 is a retrace, i.e. a
+trace-purity violation.  ``tests/test_analysis.py`` wires this into tier-1:
+a 2-step MLP ring train must hold at one trace, and a deliberately
+shape-polymorphic step is shown to trip the guard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Tuple
+
+__all__ = ["TraceCount", "retrace_guard", "check_single_trace"]
+
+
+@dataclasses.dataclass
+class TraceCount:
+    """Mutable trace counter shared with a :func:`retrace_guard` wrapper."""
+
+    count: int = 0
+
+    @property
+    def retraced(self) -> bool:
+        """True once the wrapped function has been traced more than once —
+        i.e. it recompiled after step 0."""
+        return self.count > 1
+
+
+def retrace_guard(step_fn: Callable) -> Tuple[Callable, TraceCount]:
+    """``(wrapped, counter)``: ``wrapped`` computes exactly what ``step_fn``
+    does; ``counter.count`` is how many distinct programs were compiled.
+
+    Works on plain functions and on already-jitted ones (jit-of-jit traces
+    straight through the inner cache), so it wraps ``make_train_step``'s
+    output as-is — no production seam needed.  Counting happens in the
+    wrapper's python body, which executes only at trace time; a cache hit
+    never runs python, so steady-state steps leave the counter untouched.
+    """
+    import jax
+
+    counter = TraceCount()
+
+    @functools.wraps(step_fn)
+    def counted(*args: Any, **kwargs: Any):
+        counter.count += 1
+        return step_fn(*args, **kwargs)
+
+    return jax.jit(counted), counter
+
+
+def check_single_trace(counter: TraceCount, label: str = "step") -> None:
+    """Raise ``AssertionError`` if the guarded function retraced.
+
+    Separated from the fixture so non-pytest callers (benchmarks, the live
+    session script) can assert the same invariant.
+    """
+    if counter.count == 0:
+        raise AssertionError(
+            f"{label} was never traced — the guard saw no calls")
+    if counter.retraced:
+        raise AssertionError(
+            f"{label} retraced: {counter.count} compilations for what must "
+            f"be one static program — some argument's shape/dtype/pytree "
+            f"structure changed after step 0 (see DESIGN.md §12)")

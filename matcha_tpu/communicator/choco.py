@@ -90,6 +90,8 @@ def _choco_core(vals, idx, x_hat, s, flat, flags_t, *, gather_msg, partnered_row
         if not matching_nonempty[j]:
             continue  # no edges anywhere: zero contribution, skip statically
         g_vals, g_idx = gather_msg(j)
+        # graftlint: disable=GL001 — weights, not values: α·flag·partner is
+        # the finite per-row scatter weight, never a value mask
         scale = active * flags_t[j] * alpha * partnered_rows[j]
         s = add(s, g_idx, g_vals, scale)
 
@@ -202,6 +204,8 @@ def make_choco(
             # and healing resets its rows (resilience.runtime).
             partnered_eff = partnered
             if alive is not None:
+                # graftlint: disable=GL001 — weights, not values: thins the
+                # 0/1 partner table (edge weights), all factors finite
                 partnered_eff = partnered * alive[None, :] * alive[perms]
 
             flat, x_hat, s = _choco_core(
@@ -272,6 +276,8 @@ def make_choco(
             # partner alive (partner index read from the replicated mask)
             sa = alive.reshape(C, L)[c]  # [L]
             pa = alive[jnp.asarray(perms)].reshape(M, C, L)[:, c, :]  # [M, L]
+            # graftlint: disable=GL001 — weights, not values: the folded
+            # twin of the batched partner-table thinning above
             partnered_rows = partnered_rows * sa[None, :] * pa
         return _choco_core(
             vals, idx, x_hat_blk, s_blk, flat_blk, flags_t,

