@@ -25,6 +25,11 @@ timeout -k 30 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; 
 #     the stamp carries clean=true/false either way.
 timeout -k 10 120 python lint_tpu.py --format json > benchmarks/lint_stamp_r6.json \
     || echo "lint stamp: violations recorded in benchmarks/lint_stamp_r6.json"
+#     ... and that the committed plan artifacts still verify numerically
+#     (PL001–PL008): a bench driven by a stale/tampered plan JSON measures
+#     a schedule the solver never scored.
+timeout -k 10 120 python lint_tpu.py lint-plan \
+    || echo "lint-plan: committed plan artifact(s) FAILED verification"
 
 # 1. THE driver artifact: per-step primary + chunked secondary + the
 #    overlap × wire-dtype grid (bench.py now emits `overlap_grid` by

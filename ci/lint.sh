@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# ci/lint.sh — the static-analysis gate (ISSUE 6).
+#
+# Three stages, each loud on failure; the gate fails if any stage fails:
+#
+#   1. graftlint     GL001–GL006 (syntactic) + GL101–GL104 (SPMD dataflow)
+#                    over the shipped surface, empty baseline
+#   2. lint-plan     PL001–PL008 numeric verification of every committed
+#                    schedule/plan artifact under benchmarks/
+#   3. analysis lane the same engines + the dynamic retrace sanitizer +
+#                    per-rule fixtures, as pytest (marker: analysis)
+#
+# Fast pre-commit variant: lint only what changed vs a ref —
+#
+#   ci/lint.sh --changed HEAD
+#
+# (forwards to `lint_tpu.py --changed`; plan verification and the pytest
+# lane are cheap enough to always run in full).
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+CHANGED_ARGS=()
+if [ "${1:-}" = "--changed" ]; then
+    CHANGED_ARGS=(--changed "${2:?ci/lint.sh --changed needs a git ref}")
+fi
+
+rc=0
+
+echo "== graftlint (GL0xx + GL1xx) =="
+# ${arr[@]+...} expansion: empty-array-safe under `set -u` on bash < 4.4
+python lint_tpu.py ${CHANGED_ARGS[@]+"${CHANGED_ARGS[@]}"} || rc=1
+
+echo "== planlint (lint-plan over benchmarks/) =="
+python lint_tpu.py lint-plan || rc=1
+
+echo "== analysis pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m analysis -p no:cacheprovider || rc=1
+
+exit $rc

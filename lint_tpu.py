@@ -1,18 +1,32 @@
 #!/usr/bin/env python
 """graftlint CLI — run the repo's static-analysis rules over the tree.
 
-The rules (GL001–GL006, ``matcha_tpu/analysis/rules.py``) encode the
-invariants the MATCHA-class guarantees hang on: where-not-multiply NaN
-masking, host purity of compiled code, the shared collective axis constant,
-the single wire_dtype seam, the two-phase communicator contract, loud
-failure paths.  ``tests/test_analysis.py`` runs the same engine in tier-1;
-this CLI is the interactive/CI surface.
+The rules encode the invariants the MATCHA-class guarantees hang on — the
+syntactic GL0xx family (``matcha_tpu/analysis/rules.py``: where-not-multiply
+NaN masking, host purity of compiled code, the shared collective axis
+constant, the single wire_dtype seam, the two-phase communicator contract,
+loud failure paths) and the interprocedural GL1xx SPMD-safety family
+(``spmd_rules.py``: verified ppermute permutation tables, no collectives
+under worker-divergent control flow, quantize-exactly-once wire lattice,
+static retrace prediction).  ``tests/test_analysis.py`` and
+``tests/test_dataflow.py`` run the same engine in tier-1; this CLI is the
+interactive/CI surface.
 
 Examples
 --------
 Lint the shipped surface (the tier-1 contract)::
 
     python lint_tpu.py
+
+Lint only what changed vs a ref (pre-commit speed)::
+
+    python lint_tpu.py --changed HEAD
+    python lint_tpu.py --changed origin/main
+
+Verify committed schedule/plan artifacts numerically (planlint)::
+
+    python lint_tpu.py lint-plan                # scans benchmarks/
+    python lint_tpu.py lint-plan my_plan.json
 
 JSON artifact for a live session (benchmarks/tpu_session.sh records one)::
 
@@ -28,12 +42,18 @@ Exit code 0 = clean (modulo baseline), 1 = violations, 2 = usage error.
 from __future__ import annotations
 
 import argparse
+import json
+import pathlib
+import subprocess
 import sys
 
 from matcha_tpu.analysis import (
+    PLAN_CHECKS,
     lint_paths,
+    lint_plan_paths,
     load_baseline,
     render_json,
+    render_plan_text,
     render_text,
     rules_by_id,
     write_baseline,
@@ -43,9 +63,85 @@ from matcha_tpu.analysis import (
 # tests/ is deliberately excluded — fixtures *construct* violations.
 DEFAULT_PATHS = ["matcha_tpu", "train_tpu.py", "plan_tpu.py", "bench.py"]
 DEFAULT_BASELINE = "graftlint_baseline.json"
+DEFAULT_PLAN_PATHS = ["benchmarks"]
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent
+
+
+def changed_paths(ref: str) -> list | None:
+    """The subset of the lint surface touched vs ``ref`` (tracked diffs +
+    untracked files).  None = git itself failed (bad ref / not a repo)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--", "*.py"],
+            cwd=REPO_ROOT, capture_output=True, text=True, check=True,
+        ).stdout.split()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "*.py"],
+            cwd=REPO_ROOT, capture_output=True, text=True, check=True,
+        ).stdout.split()
+    except (subprocess.CalledProcessError, OSError):
+        return None
+    surface = []
+    for rel in dict.fromkeys(diff + untracked):  # ordered de-dup
+        in_scope = any(
+            rel == p or rel.startswith(p.rstrip("/") + "/")
+            for p in DEFAULT_PATHS
+        )
+        if in_scope and (REPO_ROOT / rel).exists():
+            surface.append(rel)
+    return surface
+
+
+def main_lint_plan(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="lint_tpu.py lint-plan",
+        description="planlint: numeric verification of committed plan "
+                    "artifacts (PL001–PL008; see "
+                    "matcha_tpu/analysis/planlint.py)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help=f"plan JSONs or directories to scan "
+                        f"(default: {DEFAULT_PLAN_PATHS})")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--list-checks", action="store_true",
+                   help="print every PL check id and what it verifies")
+    args = p.parse_args(argv)
+
+    if args.list_checks:
+        for cid, what in sorted(PLAN_CHECKS.items()):
+            print(f"{cid}  {what}")
+        return 0
+
+    # relative paths resolve against the cwd first, then the repo root —
+    # the same anchoring the main lint surface gets via collect_sources, so
+    # `lint_tpu.py lint-plan` works from any directory
+    paths = []
+    for q in (args.paths or DEFAULT_PLAN_PATHS):
+        p = pathlib.Path(q)
+        if not p.exists() and not p.is_absolute() \
+                and (REPO_ROOT / p).exists():
+            p = REPO_ROOT / p
+        paths.append(p)
+    missing = [str(q) for q in paths if not q.exists()]
+    if missing:
+        print(f"lint_tpu: no such path: {missing}", file=sys.stderr)
+        return 2
+    violations, files = lint_plan_paths(paths)
+    if args.format == "json":
+        print(json.dumps({
+            "violations": [v.to_json() for v in violations],
+            "artifacts_checked": [str(f) for f in files],
+            "clean": not violations,
+        }, indent=2))
+    else:
+        print(render_plan_text(violations, files))
+    return 1 if violations else 0
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "lint-plan":
+        return main_lint_plan(argv[1:])
     p = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -63,6 +159,11 @@ def main(argv=None) -> int:
                    help="record current violations into --baseline and exit 0")
     p.add_argument("--list-rules", action="store_true",
                    help="print every rule id, title, and invariant")
+    p.add_argument("--changed", default=None, metavar="REF",
+                   help="lint only lint-surface files touched vs this git "
+                        "ref (plus untracked ones) — the fast pre-commit "
+                        "path; exits 0 immediately when nothing relevant "
+                        "changed")
     args = p.parse_args(argv)
 
     try:
@@ -77,11 +178,37 @@ def main(argv=None) -> int:
             print(f"       {r.invariant}\n")
         return 0
 
+    paths = args.paths or DEFAULT_PATHS
+    if args.changed is not None:
+        # --changed computes its own path set: combining it with explicit
+        # paths would silently discard the user's argument, and combining
+        # it with --write-baseline would rewrite the baseline from only the
+        # touched files, dropping every other file's grandfathered entries
+        if args.paths:
+            print("lint_tpu: --changed and explicit paths are mutually "
+                  "exclusive (the flag computes its own path set)",
+                  file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            print("lint_tpu: refusing --changed with --write-baseline — a "
+                  "baseline written from a partial path set drops every "
+                  "unchanged file's grandfathered entries", file=sys.stderr)
+            return 2
+        touched = changed_paths(args.changed)
+        if touched is None:
+            print(f"lint_tpu: git diff against {args.changed!r} failed "
+                  f"(bad ref, or not a git checkout)", file=sys.stderr)
+            return 2
+        if not touched:
+            print(f"lint_tpu: nothing on the lint surface changed vs "
+                  f"{args.changed}")
+            return 0
+        paths = touched
+
     baseline = set() if (args.no_baseline or args.write_baseline) \
         else load_baseline(args.baseline)
     try:
-        violations, sources = lint_paths(args.paths or DEFAULT_PATHS, rules,
-                                         baseline=baseline)
+        violations, sources = lint_paths(paths, rules, baseline=baseline)
     except FileNotFoundError as e:
         print(f"lint_tpu: no such file: {e.filename}", file=sys.stderr)
         return 2

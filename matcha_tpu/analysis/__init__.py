@@ -1,19 +1,27 @@
-"""graftlint: repo-native static analysis + trace-purity sanitizer.
+"""graftlint + graftverify: repo-native static analysis, SPMD-safety
+dataflow, plan-artifact verification, and the trace-purity sanitizer.
 
 Machine-checks the invariants earlier PRs established only as review lore:
 
-* ``engine``    — violations, inline suppressions, baseline, reporting
-* ``rules``     — GL001–GL006, the repo-specific AST checks
-* ``sanitizer`` — the dynamic retrace (recompilation) detector
+* ``engine``     — violations, inline suppressions, baseline, reporting
+* ``rules``      — GL001–GL006, the syntactic per-file checks
+* ``dataflow``   — the interprocedural layer: module call graphs, function
+  summaries, constant folding, ``# graftverify: bind`` hints
+* ``spmd_rules`` — GL101–GL104, the SPMD-safety family riding ``dataflow``
+* ``planlint``   — PL001–PL008, numeric verification of committed plan
+  artifacts (``python lint_tpu.py lint-plan``)
+* ``sanitizer``  — the dynamic retrace (recompilation) detector
 
 CLI: ``python lint_tpu.py [paths...]``; enforced in tier-1 by
-``tests/test_analysis.py`` (marker: ``analysis``).  Deliberately free of
-jax imports at module scope — the linter must run (and fail fast) even on a
-host whose accelerator backend is wedged.
+``tests/test_analysis.py`` and ``tests/test_dataflow.py`` (marker:
+``analysis``).  Deliberately free of jax imports at module scope — the
+linter must run (and fail fast) even on a host whose accelerator backend
+is wedged.
 """
 
 from .engine import (
     LintSource,
+    Rule,
     Violation,
     collect_sources,
     lint_paths,
@@ -23,21 +31,38 @@ from .engine import (
     render_text,
     write_baseline,
 )
-from .rules import ALL_RULES, Rule, rules_by_id
+from .planlint import (
+    PLAN_CHECKS,
+    discover_plan_files,
+    lint_plan_data,
+    lint_plan_file,
+    lint_plan_paths,
+    render_plan_text,
+)
+from .rules import ALL_RULES, CORE_RULES, rules_by_id
 from .sanitizer import TraceCount, check_single_trace, retrace_guard
+from .spmd_rules import SPMD_RULES
 
 __all__ = [
     "ALL_RULES",
+    "CORE_RULES",
     "LintSource",
+    "PLAN_CHECKS",
     "Rule",
+    "SPMD_RULES",
     "TraceCount",
     "Violation",
     "check_single_trace",
     "collect_sources",
+    "discover_plan_files",
     "lint_paths",
+    "lint_plan_data",
+    "lint_plan_file",
+    "lint_plan_paths",
     "lint_source",
     "load_baseline",
     "render_json",
+    "render_plan_text",
     "render_text",
     "retrace_guard",
     "rules_by_id",

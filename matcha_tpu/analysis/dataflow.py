@@ -1,0 +1,647 @@
+"""graftverify dataflow — the interprocedural layer under the GL1xx rules.
+
+The PR-5 rules (``rules.py``) are syntactic: each one pattern-matches AST
+nodes in isolation, and the one interprocedural rule (GL002) carries its own
+private call-graph walk.  The SPMD-safety family (``spmd_rules.py``) needs
+strictly more: *which functions execute inside a compiled program* (through
+``jit``/``shard_map``/transform/closure boundaries), *what each function
+does* (issue collectives, quantize the wire), and *what a permutation-table
+expression evaluates to* (where it is constant-foldable).  This module is
+that shared substrate:
+
+``ModuleGraph``
+    One parsed file's function table, transform aliases, jit/shard_map
+    roots, and lazily-computed :class:`FunctionSummary` per function —
+    with memoized transitive queries (``issues_collective``) propagated
+    over the call graph.
+
+``const_eval``
+    A closed mini-interpreter for the *schedule-building* subset of python
+    (arithmetic, comparisons, comprehensions, ``range``/``zip``/``sorted``
+    …).  It evaluates the ``perm``-building expressions feeding
+    ``lax.ppermute`` at lint time, so a one-sided send is caught before it
+    silently zeros a block on ICI.  Anything outside the subset raises
+    :class:`NotFoldable` — over-approximation stays honest.
+
+``# graftverify: bind`` hints
+    Most real perm tables close over runtime values (``C = plan.num_chips``).
+    A bind hint names the instantiations the analyzer should check::
+
+        # graftverify: bind C=1..8 part.offset=0..7
+        pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
+
+    The rule then verifies the table is a permutation for *every* binding in
+    the cross product — parametric verification of the code shape, not one
+    lucky concrete run.  Hints ride the same standalone-or-trailing comment
+    grammar as graftlint suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import itertools
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import LintSource
+
+__all__ = [
+    "COLLECTIVE_NAMES",
+    "DIVERGENT_CALLS",
+    "JIT_WRAPPERS",
+    "SHARD_MAP_NAMES",
+    "TRANSFORMS",
+    "FunctionSummary",
+    "ModuleGraph",
+    "NotFoldable",
+    "collect_aliases",
+    "collect_functions",
+    "const_eval",
+    "dotted_name",
+    "expand_bindings",
+    "jit_roots",
+    "module_graph",
+    "parse_bind_hints",
+    "walk_values",
+]
+
+
+def module_graph(source: "LintSource") -> "ModuleGraph":
+    """The memoized :class:`ModuleGraph` for a parsed file.  Four rules
+    (GL002, GL101, GL102, GL104) each need the graph; building it once per
+    source instead of once per rule saves ~10 full-AST walks per file per
+    lint run.  Cached on the source object itself so the cache's lifetime
+    is exactly the source's."""
+    graph = source.__dict__.get("_module_graph")
+    if graph is None:
+        graph = ModuleGraph(source)
+        source.__dict__["_module_graph"] = graph
+    return graph
+
+
+# --------------------------------------------------------------------------
+# Shared AST vocabulary (single source of truth for rules.py + spmd_rules.py)
+# --------------------------------------------------------------------------
+
+JIT_WRAPPERS = {"jit", "jax.jit", "pjit", "jax.pjit", "pmap", "jax.pmap"}
+SHARD_MAP_NAMES = {"shard_map", "jax.shard_map",
+                   "jax.experimental.shard_map.shard_map"}
+# transforms whose function arguments execute at trace time inside the
+# enclosing compiled program — reachability flows through them
+TRANSFORMS = {
+    "jax.vmap", "vmap", "jax.grad", "grad", "jax.value_and_grad",
+    "value_and_grad", "jax.checkpoint", "checkpoint", "jax.remat", "remat",
+    "jax.lax.scan", "lax.scan", "scan", "jax.lax.cond", "lax.cond", "cond",
+    "jax.lax.map", "lax.map", "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.while_loop", "lax.while_loop", "lax.switch", "jax.lax.switch",
+    "functools.partial", "partial",
+}
+# collective primitives over the worker axis — the SPMD lockstep surface
+COLLECTIVE_NAMES = {
+    "ppermute", "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "pshuffle",
+}
+# calls whose result differs per worker/process — the seeds of divergent
+# python control flow (GL102)
+DIVERGENT_CALLS = {"axis_index", "process_index"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_values(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into Subscript indices: in
+    ``delta[alive_idx]`` the index is row *selection*, not a factor of the
+    product, so it must not make the expression look mask-scaled."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for field, value in ast.iter_fields(n):
+            if isinstance(n, ast.Subscript) and field == "slice":
+                continue
+            if isinstance(value, ast.AST):
+                stack.append(value)
+            elif isinstance(value, list):
+                stack.extend(v for v in value if isinstance(v, ast.AST))
+
+
+def collect_functions(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> def nodes (module-level and nested alike; lambdas bound by
+    simple assignment count too)."""
+    table: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            table.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Lambda):
+            table.setdefault(node.targets[0].id, []).append(node.value)
+    return table
+
+
+def collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    """``g = jax.vmap(f)``-style bindings: alias name -> wrapped name."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        fn = dotted_name(node.value.func)
+        if fn in TRANSFORMS | JIT_WRAPPERS | SHARD_MAP_NAMES:
+            for arg in node.value.args:
+                if isinstance(arg, ast.Name):
+                    aliases[node.targets[0].id] = arg.id
+                    break
+    return aliases
+
+
+def jit_roots(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(label, def-node) pairs entering compilation: @jax.jit decorations,
+    jit(f)/shard_map(f) call arguments (names and lambdas alike)."""
+    roots: List[Tuple[str, ast.AST]] = []
+    table = collect_functions(tree)
+
+    def _is_jit_decorator(dec: ast.AST) -> bool:
+        name = dotted_name(dec)
+        if name in JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            fn = dotted_name(dec.func)
+            if fn in JIT_WRAPPERS:
+                return True
+            if fn in ("functools.partial", "partial") and dec.args:
+                return dotted_name(dec.args[0]) in JIT_WRAPPERS
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                roots.append((node.name, node))
+        elif isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn in JIT_WRAPPERS or fn in SHARD_MAP_NAMES \
+                    or (fn is not None and fn.endswith("shard_map")):
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        roots.append((f"<lambda@{arg.lineno}>", arg))
+                    elif isinstance(arg, ast.Name) and arg.id in table:
+                        for defn in table[arg.id]:
+                            roots.append((arg.id, defn))
+                    break  # only the first argument is the traced callable
+    return roots
+
+
+def static_params(fn_node: ast.AST) -> Set[str]:
+    """Parameter names pinned by ``static_argnames``/``static_argnums`` in a
+    jit decorator (values the cache key deliberately covers — a new value
+    recompiling is declared behavior, not a retrace hazard)."""
+    out: Set[str] = set()
+    decorators = getattr(fn_node, "decorator_list", [])
+    args = getattr(fn_node, "args", None)
+    if args is None:
+        return out
+    names = [a.arg for a in args.posonlyargs + args.args]
+    for dec in decorators:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        out.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                            and 0 <= n.value < len(names):
+                        out.add(names[n.value])
+    return out
+
+
+# --------------------------------------------------------------------------
+# Function summaries + the module call graph
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FunctionSummary:
+    """What one function does, as the interprocedural rules see it."""
+
+    name: str
+    node: ast.AST
+    calls: Set[str]  # callee names (dotted, as written)
+    collective_sites: List[ast.Call]  # direct lax.ppermute/psum/… calls
+    divergent_names: Set[str]  # names assigned from axis_index/process_index
+
+    @property
+    def issues_collective_directly(self) -> bool:
+        return bool(self.collective_sites)
+
+
+def _summarize(name: str, fn_node: ast.AST) -> FunctionSummary:
+    calls: Set[str] = set()
+    collectives: List[ast.Call] = []
+    divergent: Set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call):
+            fn = dotted_name(n.func)
+            if fn is not None:
+                calls.add(fn)
+                if fn.split(".")[-1] in COLLECTIVE_NAMES:
+                    collectives.append(n)
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            callee = dotted_name(n.value.func)
+            if callee is not None \
+                    and callee.split(".")[-1] in DIVERGENT_CALLS:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        divergent.add(t.id)
+    return FunctionSummary(name=name, node=fn_node, calls=calls,
+                           collective_sites=collectives,
+                           divergent_names=divergent)
+
+
+class ModuleGraph:
+    """One file's functions, aliases, compiled roots, and summaries.
+
+    The graph is *per translation unit* on purpose: cross-file resolution
+    would need import semantics the linter cannot honestly model, and every
+    invariant the GL1xx family checks lives within one module's seam
+    (``parallel/gossip.py``'s ppermutes, a communicator's begin/apply pair).
+    """
+
+    def __init__(self, source: LintSource):
+        self.source = source
+        self.functions = collect_functions(source.tree)
+        self.aliases = collect_aliases(source.tree)
+        self.roots = jit_roots(source.tree)
+        self._summaries: Dict[int, FunctionSummary] = {}
+        self._issues_memo: Dict[int, bool] = {}
+
+    # ----- resolution ------------------------------------------------------
+
+    def resolve(self, name: str) -> List[ast.AST]:
+        """def nodes a (possibly transform-aliased) name may refer to."""
+        name = self.aliases.get(name, name)
+        defs = self.functions.get(name, [])
+        if not defs and "." in name:  # self.helper / module.helper: last part
+            defs = self.functions.get(name.split(".")[-1], [])
+        return defs
+
+    def summary(self, fn_node: ast.AST, name: str = "?") -> FunctionSummary:
+        key = id(fn_node)
+        if key not in self._summaries:
+            self._summaries[key] = _summarize(name, fn_node)
+        return self._summaries[key]
+
+    # ----- transitive queries ---------------------------------------------
+
+    def issues_collective(self, fn_node: ast.AST,
+                          _visiting: Optional[Set[int]] = None) -> bool:
+        """Does this function (transitively, through local calls) execute a
+        collective?  The summary-propagation query GL102 deadlock detection
+        runs at every call site under divergent control flow."""
+        key = id(fn_node)
+        if key in self._issues_memo:
+            return self._issues_memo[key]
+        visiting = _visiting if _visiting is not None else set()
+        if key in visiting:  # recursion cycle: no new information
+            return False
+        visiting.add(key)
+        s = self.summary(fn_node)
+        result = s.issues_collective_directly
+        if not result:
+            for callee in s.calls:
+                for defn in self.resolve(callee):
+                    if defn is not fn_node \
+                            and self.issues_collective(defn, visiting):
+                        result = True
+                        break
+                if result:
+                    break
+        self._issues_memo[key] = result
+        return result
+
+    def compiled_functions(self) -> List[Tuple[str, ast.AST]]:
+        """Every function reachable from a jit/shard_map root, labeled with
+        the root it is reachable from — through plain local calls, transform
+        wrappers (``vmap(f)``), aliases, and nested defs (closures live
+        inside their parent's AST, so the walk crosses closure boundaries
+        for free)."""
+        out: List[Tuple[str, ast.AST]] = []
+        seen: Set[int] = set()
+
+        def scan(fn_node: ast.AST, root: str) -> None:
+            if id(fn_node) in seen:
+                return
+            seen.add(id(fn_node))
+            out.append((root, fn_node))
+            for n in ast.walk(fn_node):
+                if not isinstance(n, ast.Call):
+                    continue
+                fn = dotted_name(n.func)
+                if fn is None:
+                    continue
+                for defn in self.resolve(fn):
+                    if defn is not fn_node:
+                        scan(defn, root)
+                if fn in TRANSFORMS:
+                    for arg in n.args:
+                        if isinstance(arg, ast.Name):
+                            for defn in self.resolve(arg.id):
+                                scan(defn, root)
+                        elif isinstance(arg, ast.Lambda):
+                            scan(arg, root)
+
+        for root_name, root_node in self.roots:
+            scan(root_node, root_name)
+        return out
+
+    _compiled_cache: Optional[List[Tuple[str, ast.AST]]] = None
+
+    def compiled_functions_cached(self) -> List[Tuple[str, ast.AST]]:
+        if self._compiled_cache is None:
+            self._compiled_cache = self.compiled_functions()
+        return self._compiled_cache
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        """Innermost def containing ``node`` (line/col containment walk)."""
+        best: Optional[ast.AST] = None
+        for fn_nodes in self.functions.values():
+            for fn in fn_nodes:
+                lo = getattr(fn, "lineno", None)
+                hi = getattr(fn, "end_lineno", None)
+                line = getattr(node, "lineno", None)
+                if lo is None or hi is None or line is None:
+                    continue
+                if lo <= line <= hi:
+                    if best is None or getattr(best, "lineno", 0) < lo:
+                        best = fn
+        return best
+
+
+# --------------------------------------------------------------------------
+# Constant folding: the schedule-building python subset
+# --------------------------------------------------------------------------
+
+class NotFoldable(Exception):
+    """The expression leaves the statically-evaluable subset (or exceeds the
+    operation budget)."""
+
+
+_FOLD_CALLS = {
+    "range": range, "len": len, "sorted": sorted, "list": list,
+    "tuple": tuple, "set": set, "enumerate": enumerate, "zip": zip,
+    "min": min, "max": max, "abs": abs, "sum": sum, "reversed": reversed,
+    "divmod": divmod,
+}
+
+_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Mod: lambda a, b: a % b,
+    ast.Pow: lambda a, b: a ** b,
+    ast.Div: lambda a, b: a / b,
+    ast.LShift: lambda a, b: a << b,
+    ast.RShift: lambda a, b: a >> b,
+    ast.BitOr: lambda a, b: a | b,
+    ast.BitAnd: lambda a, b: a & b,
+    ast.BitXor: lambda a, b: a ^ b,
+}
+
+_CMPOPS = {
+    ast.Eq: lambda a, b: a == b,
+    ast.NotEq: lambda a, b: a != b,
+    ast.Lt: lambda a, b: a < b,
+    ast.LtE: lambda a, b: a <= b,
+    ast.Gt: lambda a, b: a > b,
+    ast.GtE: lambda a, b: a >= b,
+    ast.In: lambda a, b: a in b,
+    ast.NotIn: lambda a, b: a not in b,
+}
+
+_MAX_FOLD_OPS = 20000
+
+
+def const_eval(node: ast.AST, env: Optional[Dict[str, object]] = None):
+    """Evaluate an expression under ``env`` (names *and* dotted attribute
+    chains, e.g. ``{"C": 4, "part.offset": 1}``) within the closed
+    schedule-building subset.  Raises :class:`NotFoldable` on anything
+    outside it — no attribute access on values, no methods, no builtins
+    beyond the whitelist, bounded total operation count."""
+    env = dict(env or {})
+    budget = [_MAX_FOLD_OPS]
+
+    def ev(n: ast.AST, scope: Dict[str, object]):
+        budget[0] -= 1
+        if budget[0] < 0:
+            raise NotFoldable("operation budget exceeded")
+        if isinstance(n, ast.Constant):
+            return n.value
+        if isinstance(n, ast.Name):
+            if n.id in scope:
+                return scope[n.id]
+            raise NotFoldable(f"unbound name `{n.id}`")
+        if isinstance(n, ast.Attribute):
+            d = dotted_name(n)
+            if d is not None and d in scope:
+                return scope[d]
+            raise NotFoldable(f"unbound attribute `{d or '?'}`")
+        if isinstance(n, ast.BinOp):
+            op = _BINOPS.get(type(n.op))
+            if op is None:
+                raise NotFoldable(f"operator {type(n.op).__name__}")
+            return op(ev(n.left, scope), ev(n.right, scope))
+        if isinstance(n, ast.UnaryOp):
+            v = ev(n.operand, scope)
+            if isinstance(n.op, ast.USub):
+                return -v
+            if isinstance(n.op, ast.UAdd):
+                return +v
+            if isinstance(n.op, ast.Not):
+                return not v
+            if isinstance(n.op, ast.Invert):
+                return ~v
+            raise NotFoldable("unary operator")
+        if isinstance(n, ast.BoolOp):
+            vals = [ev(v, scope) for v in n.values]
+            return all(vals) if isinstance(n.op, ast.And) else any(vals)
+        if isinstance(n, ast.Compare):
+            left = ev(n.left, scope)
+            for op, right_n in zip(n.ops, n.comparators):
+                fn = _CMPOPS.get(type(op))
+                if fn is None:
+                    raise NotFoldable("comparison operator")
+                right = ev(right_n, scope)
+                if not fn(left, right):
+                    return False
+                left = right
+            return True
+        if isinstance(n, ast.IfExp):
+            return ev(n.body, scope) if ev(n.test, scope) \
+                else ev(n.orelse, scope)
+        if isinstance(n, ast.Tuple):
+            return tuple(ev(e, scope) for e in n.elts)
+        if isinstance(n, (ast.List, ast.Set)):
+            vals = [ev(e, scope) for e in n.elts]
+            return vals if isinstance(n, ast.List) else set(vals)
+        if isinstance(n, ast.Subscript):
+            return ev(n.value, scope)[ev(n.slice, scope)]
+        if isinstance(n, ast.Slice):
+            return slice(
+                None if n.lower is None else ev(n.lower, scope),
+                None if n.upper is None else ev(n.upper, scope),
+                None if n.step is None else ev(n.step, scope))
+        if isinstance(n, ast.Call):
+            fn = dotted_name(n.func)
+            if fn not in _FOLD_CALLS or n.keywords:
+                raise NotFoldable(f"call to `{fn or '?'}`")
+            return _FOLD_CALLS[fn](*[ev(a, scope) for a in n.args])
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            out: List[object] = []
+
+            def run(gens: Sequence[ast.comprehension],
+                    scope: Dict[str, object]) -> None:
+                budget[0] -= 1
+                if budget[0] < 0:
+                    raise NotFoldable("operation budget exceeded")
+                if not gens:
+                    out.append(ev(n.elt, scope))
+                    return
+                g = gens[0]
+                for item in ev(g.iter, scope):
+                    inner = dict(scope)
+                    _bind_target(g.target, item, inner)
+                    if all(ev(cond, inner) for cond in g.ifs):
+                        run(gens[1:], inner)
+
+            run(n.generators, dict(scope))
+            return set(out) if isinstance(n, ast.SetComp) else out
+        raise NotFoldable(type(n).__name__)
+
+    return ev(node, env)
+
+
+def _bind_target(target: ast.AST, value, scope: Dict[str, object]) -> None:
+    if isinstance(target, ast.Name):
+        scope[target.id] = value
+    elif isinstance(target, ast.Tuple):
+        vals = list(value)
+        if len(vals) != len(target.elts):
+            raise NotFoldable("destructuring arity mismatch")
+        for t, v in zip(target.elts, vals):
+            _bind_target(t, v, scope)
+    else:
+        raise NotFoldable("comprehension target")
+
+
+def free_names(node: ast.AST) -> Set[str]:
+    """Names (plain and dotted) an expression reads, minus
+    comprehension-bound targets — what ``const_eval`` needs from its env."""
+    bound: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            for g in n.generators:
+                for t in ast.walk(g.target):
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+    out: Set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute):
+            d = dotted_name(n)
+            if d is not None:
+                if d.split(".")[0] not in bound:
+                    out.add(d)
+                return  # whole chain is one symbol — don't recurse to Name
+            for child in ast.iter_child_nodes(n):
+                visit(child)
+            return
+        if isinstance(n, ast.Name):
+            if n.id not in bound and n.id not in _FOLD_CALLS:
+                out.add(n.id)
+            return
+        if isinstance(n, ast.Call):
+            fn = dotted_name(n.func)
+            if fn in _FOLD_CALLS:  # builtin whitelist, not a free symbol
+                for a in n.args:
+                    visit(a)
+                for kw in n.keywords:
+                    visit(kw.value)
+                return
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+# --------------------------------------------------------------------------
+# bind hints: `# graftverify: bind NAME=1..8 other.name=0,2,4`
+# --------------------------------------------------------------------------
+
+_BIND_RE = re.compile(r"#\s*graftverify:\s*bind\s+(.*)")
+_ASSIGN_RE = re.compile(r"([A-Za-z_][\w.]*)=([0-9.,\-]+)")
+_MAX_BINDINGS = 512
+
+
+def _parse_values(spec: str) -> List[int]:
+    """``1..8`` inclusive range or ``1,2,4`` comma list (ints only — the
+    symbols being bound are device counts and ring offsets).  A malformed
+    spec returns [] rather than raising: the empty expansion then surfaces
+    as a GL101 violation at the hinted site instead of a traceback that
+    kills the whole lint run (review finding, ISSUE 6)."""
+    try:
+        if ".." in spec:
+            lo, hi = spec.split("..", 1)
+            return list(range(int(lo), int(hi) + 1))
+        return [int(tok) for tok in spec.split(",") if tok.strip()]
+    except ValueError:
+        return []
+
+
+def parse_bind_hints(lines: Sequence[str]) -> Dict[int, Dict[str, List[int]]]:
+    """Per-line bind tables, with the same standalone-comment attachment
+    rule as graftlint suppressions (shared helper:
+    ``engine.attach_to_next_code_line``): a line holding only the comment
+    binds the next code line."""
+    from .engine import attach_to_next_code_line
+
+    table: Dict[int, Dict[str, List[int]]] = {}
+    for lineno, line in enumerate(lines, 1):
+        m = _BIND_RE.search(line)
+        if not m:
+            continue
+        binds = {name: _parse_values(spec)
+                 for name, spec in _ASSIGN_RE.findall(m.group(1))}
+        if not binds:
+            continue
+        table.setdefault(attach_to_next_code_line(lines, lineno),
+                         {}).update(binds)
+    return table
+
+
+def expand_bindings(binds: Dict[str, List[int]]) -> List[Dict[str, int]]:
+    """Cross product of the hint's value lists, capped at ``_MAX_BINDINGS``
+    (beyond that the hint is effectively a fuzz request, not a proof
+    obligation — the cap keeps lint time bounded)."""
+    if not binds:
+        return [{}]
+    names = sorted(binds)
+    combos = list(itertools.islice(
+        itertools.product(*(binds[n] for n in names)), _MAX_BINDINGS + 1))
+    if len(combos) > _MAX_BINDINGS:
+        combos = combos[:_MAX_BINDINGS]
+    return [dict(zip(names, c)) for c in combos]

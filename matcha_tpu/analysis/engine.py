@@ -48,6 +48,7 @@ from typing import Dict, Iterable, List, Sequence, Set, Tuple
 
 __all__ = [
     "LintSource",
+    "Rule",
     "Violation",
     "collect_sources",
     "lint_paths",
@@ -93,6 +94,47 @@ class LintSource:
         return rule_id in self.suppressions.get(line, set())
 
 
+class Rule:
+    """Base for every lint rule (GL0xx in ``rules.py``, GL1xx in
+    ``spmd_rules.py``): subclasses define ``id``, ``title``, ``invariant``
+    and ``check(source) -> list[Violation]``.  Lives in the engine so both
+    rule families share it without an import cycle."""
+
+    id = "GL000"
+    title = ""
+    invariant = ""
+
+    def check(self, source: "LintSource") -> List[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def hit(self, source: "LintSource", node, message: str) -> Violation:
+        return Violation(
+            rule=self.id, path=source.path,
+            line=getattr(node, "lineno", 1), col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+def attach_to_next_code_line(lines: Sequence[str], lineno: int) -> int:
+    """The line a standalone comment annotation applies to.
+
+    A comment on its own line annotates the next *code* line (blank and
+    continuation-comment lines in between are skipped); a trailing comment
+    annotates its own line.  One helper for both annotation grammars —
+    graftlint suppressions here and graftverify ``bind`` hints in
+    ``dataflow.parse_bind_hints`` — so the attachment rule can never
+    silently diverge between them.
+    """
+    if not lines[lineno - 1].lstrip().startswith("#"):
+        return lineno  # trailing form: annotates its own line
+    target = lineno + 1
+    while target <= len(lines) and (
+            not lines[target - 1].strip()
+            or lines[target - 1].lstrip().startswith("#")):
+        target += 1
+    return target
+
+
 def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
     """Per-line suppression table.
 
@@ -107,14 +149,8 @@ def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
         if not m:
             continue
         ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
-        target = lineno
-        if line.lstrip().startswith("#"):  # standalone: walk to the code line
-            target = lineno + 1
-            while target <= len(lines) and (
-                    not lines[target - 1].strip()
-                    or lines[target - 1].lstrip().startswith("#")):
-                target += 1
-        table.setdefault(target, set()).update(ids)
+        table.setdefault(attach_to_next_code_line(lines, lineno),
+                         set()).update(ids)
     return table
 
 

@@ -12,6 +12,10 @@ GL005     one-sided ``begin_mix``/``apply_mix`` overrides (two-phase contract)
 GL006     bare ``except`` / swallowed exceptions
 ========  ==================================================================
 
+The interprocedural GL1xx family (SPMD-safety dataflow) lives in
+``spmd_rules.py`` on the shared :mod:`dataflow` layer; ``ALL_RULES`` at the
+bottom of this file is the union both the CLI and tier-1 run.
+
 Rules over-approximate on purpose: a flagged site is either converted to the
 safe form or suppressed inline *with a reason* — the reason is the artifact
 (e.g. ``# graftlint: disable=GL001 — weights, not values``).  The shipped
@@ -23,59 +27,17 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+from typing import List, Optional, Sequence, Set, Tuple
 
-from .engine import LintSource, Violation
+from .dataflow import (
+    COLLECTIVE_NAMES,
+    dotted_name as _dotted,
+    module_graph,
+    walk_values as _walk_values,
+)
+from .engine import LintSource, Rule, Violation
 
-__all__ = ["ALL_RULES", "Rule", "rules_by_id"]
-
-
-class Rule:
-    """Base: subclasses define ``id``, ``title``, ``invariant`` and
-    ``check(source) -> list[Violation]``."""
-
-    id = "GL000"
-    title = ""
-    invariant = ""
-
-    def check(self, source: LintSource) -> List[Violation]:  # pragma: no cover
-        raise NotImplementedError
-
-    def hit(self, source: LintSource, node: ast.AST, message: str) -> Violation:
-        return Violation(
-            rule=self.id, path=source.path,
-            line=getattr(node, "lineno", 1), col=getattr(node, "col_offset", 0),
-            message=message,
-        )
-
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """``a.b.c`` for a Name/Attribute chain, else None."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _walk_values(node: ast.AST) -> Iterator[ast.AST]:
-    """ast.walk that does not descend into Subscript indices: in
-    ``delta[alive_idx]`` the index is row *selection*, not a factor of the
-    product, so it must not make the expression look mask-scaled."""
-    stack = [node]
-    while stack:
-        n = stack.pop()
-        yield n
-        for field, value in ast.iter_fields(n):
-            if isinstance(n, ast.Subscript) and field == "slice":
-                continue
-            if isinstance(value, ast.AST):
-                stack.append(value)
-            elif isinstance(value, list):
-                stack.extend(v for v in value if isinstance(v, ast.AST))
+__all__ = ["ALL_RULES", "CORE_RULES", "Rule", "rules_by_id"]
 
 
 # =========================================================================
@@ -164,19 +126,6 @@ class GL001MultiplyMasking(Rule):
 # GL002 — host impurity reachable from compiled code
 # =========================================================================
 
-_JIT_WRAPPERS = {"jit", "jax.jit", "pjit", "jax.pjit", "pmap", "jax.pmap"}
-_SHARD_MAP = {"shard_map", "jax.shard_map",
-              "jax.experimental.shard_map.shard_map"}
-# transforms whose function arguments execute at trace time inside the
-# enclosing compiled program — reachability flows through them
-_TRANSFORMS = {
-    "jax.vmap", "vmap", "jax.grad", "grad", "jax.value_and_grad",
-    "value_and_grad", "jax.checkpoint", "checkpoint", "jax.remat", "remat",
-    "jax.lax.scan", "lax.scan", "scan", "jax.lax.cond", "lax.cond", "cond",
-    "jax.lax.map", "lax.map", "jax.lax.fori_loop", "lax.fori_loop",
-    "jax.lax.while_loop", "lax.while_loop", "lax.switch", "jax.lax.switch",
-    "functools.partial", "partial",
-}
 _IMPURE_EXACT = {
     "time.time": "wall-clock freezes to a trace-time constant inside jit",
     "time.perf_counter": "wall-clock freezes to a trace-time constant",
@@ -196,73 +145,6 @@ _IMPURE_PREFIX = {
     "random.": "python randomness is drawn once at trace time — use "
                "jax.random with a threaded key",
 }
-
-
-def _collect_functions(tree: ast.AST) -> Dict[str, List[ast.AST]]:
-    """name -> def nodes (module-level and nested alike; lambdas bound by
-    simple assignment count too)."""
-    table: Dict[str, List[ast.AST]] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            table.setdefault(node.name, []).append(node)
-        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Lambda):
-            table.setdefault(node.targets[0].id, []).append(node.value)
-    return table
-
-
-def _collect_aliases(tree: ast.AST) -> Dict[str, str]:
-    """``g = jax.vmap(f)``-style bindings: alias name -> wrapped name."""
-    aliases: Dict[str, str] = {}
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and isinstance(node.value, ast.Call)):
-            continue
-        fn = _dotted(node.value.func)
-        if fn in _TRANSFORMS | _JIT_WRAPPERS | _SHARD_MAP:
-            for arg in node.value.args:
-                if isinstance(arg, ast.Name):
-                    aliases[node.targets[0].id] = arg.id
-                    break
-    return aliases
-
-
-def _jit_roots(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
-    """(label, def-node) pairs entering compilation: @jax.jit decorations,
-    jit(f)/shard_map(f) call arguments (names and lambdas alike)."""
-    roots: List[Tuple[str, ast.AST]] = []
-    table = _collect_functions(tree)
-
-    def _is_jit_decorator(dec: ast.AST) -> bool:
-        name = _dotted(dec)
-        if name in _JIT_WRAPPERS:
-            return True
-        if isinstance(dec, ast.Call):
-            fn = _dotted(dec.func)
-            if fn in _JIT_WRAPPERS:
-                return True
-            if fn in ("functools.partial", "partial") and dec.args:
-                return _dotted(dec.args[0]) in _JIT_WRAPPERS
-        return False
-
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(_is_jit_decorator(d) for d in node.decorator_list):
-                roots.append((node.name, node))
-        elif isinstance(node, ast.Call):
-            fn = _dotted(node.func)
-            if fn in _JIT_WRAPPERS or fn in _SHARD_MAP \
-                    or (fn is not None and fn.endswith("shard_map")):
-                for arg in node.args:
-                    if isinstance(arg, ast.Lambda):
-                        roots.append((f"<lambda@{arg.lineno}>", arg))
-                    elif isinstance(arg, ast.Name) and arg.id in table:
-                        for defn in table[arg.id]:
-                            roots.append((arg.id, defn))
-                    break  # only the first argument is the traced callable
-    return roots
 
 
 class GL002HostImpurity(Rule):
@@ -295,20 +177,12 @@ class GL002HostImpurity(Rule):
         return None
 
     def check(self, source: LintSource) -> List[Violation]:
-        table = _collect_functions(source.tree)
-        aliases = _collect_aliases(source.tree)
+        # the reachability walk (call graph + transform aliases + closures)
+        # now lives in the shared dataflow layer the GL1xx family also rides
+        graph = module_graph(source)
         out: List[Violation] = []
         reported: Set[int] = set()
-        visited: Set[int] = set()
-
-        def resolve(name: str) -> List[ast.AST]:
-            name = aliases.get(name, name)
-            return table.get(name, [])
-
-        def scan(fn_node: ast.AST, root: str) -> None:
-            if id(fn_node) in visited:
-                return
-            visited.add(id(fn_node))
+        for root, fn_node in graph.compiled_functions_cached():
             for n in ast.walk(fn_node):
                 if not isinstance(n, ast.Call):
                     continue
@@ -318,23 +192,6 @@ class GL002HostImpurity(Rule):
                     out.append(self.hit(
                         source, n,
                         f"{why} [reachable from compiled `{root}`]"))
-                fn = _dotted(n.func)
-                if fn is not None:
-                    # plain local call: f(...)
-                    for defn in resolve(fn):
-                        if defn is not fn_node:
-                            scan(defn, root)
-                    # higher-order transform: vmap(f)(...) etc.
-                    if fn in _TRANSFORMS:
-                        for arg in n.args:
-                            if isinstance(arg, ast.Name):
-                                for defn in resolve(arg.id):
-                                    scan(defn, root)
-                            elif isinstance(arg, ast.Lambda):
-                                scan(arg, root)
-
-        for root_name, root_node in _jit_roots(source.tree):
-            scan(root_node, root_name)
         return out
 
 
@@ -342,10 +199,9 @@ class GL002HostImpurity(Rule):
 # GL003 — string-literal collective axis names
 # =========================================================================
 
-_COLLECTIVES = {
-    "ppermute", "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
-    "psum_scatter", "axis_index", "pshuffle",
-}
+# axis_index also takes an axis *name* even though it moves no data — for
+# the literal-name check it counts as a collective call site
+_COLLECTIVES = COLLECTIVE_NAMES | {"axis_index"}
 
 
 class GL003LiteralAxisName(Rule):
@@ -544,7 +400,7 @@ class GL006SwallowedExceptions(Rule):
         return out
 
 
-ALL_RULES: Tuple[Rule, ...] = (
+CORE_RULES: Tuple[Rule, ...] = (
     GL001MultiplyMasking(),
     GL002HostImpurity(),
     GL003LiteralAxisName(),
@@ -552,6 +408,12 @@ ALL_RULES: Tuple[Rule, ...] = (
     GL005TwoPhaseContract(),
     GL006SwallowedExceptions(),
 )
+
+# imported at the bottom so spmd_rules (which imports Rule via engine and
+# the dataflow layer) can never cycle back into a half-initialized module
+from .spmd_rules import SPMD_RULES  # noqa: E402
+
+ALL_RULES: Tuple[Rule, ...] = CORE_RULES + SPMD_RULES
 
 
 def rules_by_id(ids: Optional[Sequence[str]] = None) -> Tuple[Rule, ...]:

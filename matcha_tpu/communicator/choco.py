@@ -254,6 +254,9 @@ def make_choco(
                 if part.offset == 0:
                     yv, yi = vals, idx
                 else:
+                    # graftverify: bind C=1..8 part.offset=0..7
+                    # (GL101: the ring table is a permutation for every
+                    # binding; same shape as gossip_mix_folded's)
                     pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
                     if wire is None:
                         yv = lax.ppermute(vals, axis, pairs)

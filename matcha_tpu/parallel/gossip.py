@@ -445,6 +445,9 @@ def gossip_mix_folded(
                 if part.offset == 0:
                     y = xw
                 else:
+                    # graftverify: bind C=1..8 part.offset=0..7
+                    # (GL101 verifies the ring table is a permutation for
+                    # every binding — offsets ≥ C wrap through the modulus)
                     pairs = [((cc + part.offset) % C, cc) for cc in range(C)]
                     y = lax.ppermute(xw_wire, axis, pairs).astype(x_blk.dtype)
                 src = jnp.asarray(part.src_local)[c]  # [L]

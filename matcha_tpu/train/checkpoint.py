@@ -17,6 +17,7 @@ it (plus cursor-vs-horizon bounds) when handed the resuming schedule.
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import json
 import os
@@ -124,7 +125,32 @@ def restore_checkpoint(directory: str, template: TrainState,
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {directory}")
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
-    state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    try:
+        state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    except ValueError as e:
+        # Legacy (pre-PR4) checkpoint: the saved tree predates
+        # TrainState.mix_pending, so orbax raises `Dict key mismatch` against
+        # any template that carries the slot (both the array and `()` forms —
+        # ROADMAP PR-5 finding).  Restore through a mix_pending-free template
+        # and re-attach the empty slot: a checkpoint written before the
+        # overlapped pipeline existed truthfully carries no in-flight delta,
+        # and `_reconcile_mix_pending` in train/loop.py primes a zero delta
+        # if this run resumes with --overlap 1step.
+        if "mismatch" not in str(e).lower():
+            raise
+        legacy_abstract = {
+            f.name: getattr(abstract, f.name)
+            for f in dataclasses.fields(template)
+            if f.name != "mix_pending"
+        }
+        try:
+            restored = mgr.restore(
+                step, args=ocp.args.StandardRestore(legacy_abstract))
+        except Exception:
+            mgr.close()
+            raise e  # not the legacy shape either: the original error names
+            # the real mismatch
+        state = template.replace(**restored, mix_pending=())
     mgr.close()
     if schedule is not None:
         cursor = int(np.asarray(state.step))

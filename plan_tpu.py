@@ -228,6 +228,17 @@ def cmd_sweep(args) -> int:
         solver_iters=args.solver_iters, mc_trials=args.mc_trials,
         mc_steps=args.mc_steps)
     save_plan(artifact, args.out)
+    # planlint self-check: a sweep must never emit an artifact the verifier
+    # (lint_tpu.py lint-plan, run over benchmarks/ in tier-1) would reject —
+    # catching a solver/artifact drift at write time, not at review time
+    from matcha_tpu.analysis import lint_plan_file, render_plan_text
+
+    plan_violations, _ = lint_plan_file(args.out)
+    if plan_violations:
+        print(render_plan_text(plan_violations, [args.out]), file=sys.stderr)
+        print(f"# wrote {args.out}, but it FAILS planlint — do not commit",
+              file=sys.stderr)
+        return 1
     best = artifact.chosen
     print(f"# wrote {args.out}", file=sys.stderr)
     print(json.dumps({

@@ -246,12 +246,17 @@ def test_choco_stochastic_shard_map_contracts():
 
     # multi_step (one shard_map scan) ≡ per-step driving: the key schedule is
     # bit-identical (same split-per-step recurrence), the state agrees up to
-    # f32 reassociation between the fused and per-step compiled programs
+    # f32 reassociation between the fused and per-step compiled programs.
+    # The per-step driver is jitted ONCE and reused — driving comm.step
+    # eagerly re-traced the shard_map program on every call and was the
+    # single most expensive line in tier-1 (~140 s for 8 steps vs ~2 s
+    # compiled; ISSUE 6 wall-clock audit), without asserting anything more.
     flags8 = sched.flags[:8]
     a, ca = comm.multi_step(xs, comm.init(xs), jnp.asarray(flags8, jnp.float32))
+    step_j = jax.jit(comm.step)
     b, cb = xs, comm.init(xs)
     for t in range(8):
-        b, cb = comm.step(b, cb, jnp.asarray(flags8[t], jnp.float32))
+        b, cb = step_j(b, cb, jnp.asarray(flags8[t], jnp.float32))
     np.testing.assert_array_equal(np.asarray(ca["key"]), np.asarray(cb["key"]))
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(ca["s"]), np.asarray(cb["s"]),

@@ -45,3 +45,52 @@ def test_scanner_sees_the_committed_artifacts():
     hits = [ref for doc in _docs() for ref in REF.findall(doc.read_text())]
     assert hits, "no benchmarks/*.json references found — pattern rotted?"
     assert any((REPO / ref).exists() for ref in hits)
+
+
+# --------------------------------------------------------------- lint stamps
+# benchmarks/tpu_session.sh step 0.1 records `lint_tpu.py --format json` next
+# to the bench captures; DESIGN.md cites the stamp as evidence the measured
+# tree passed graftlint.  Pin the stamp schema here so (a) every committed
+# stamp parses as what the docs claim it is, and (b) the renderer cannot
+# silently change shape between sessions — the same contract style as the
+# benchmark-reference scan above.
+
+_STAMP_KEYS = {"violations", "files_checked", "rules", "clean"}
+
+
+def _assert_stamp_schema(data, where):
+    assert _STAMP_KEYS <= set(data), (
+        f"{where}: lint stamp missing keys {_STAMP_KEYS - set(data)}")
+    assert isinstance(data["clean"], bool), where
+    assert isinstance(data["files_checked"], int), where
+    assert isinstance(data["violations"], list), where
+    for v in data["violations"]:
+        assert {"rule", "path", "line", "col", "message"} <= set(v), (
+            f"{where}: malformed violation entry {v}")
+    rule_ids = {r["id"] for r in data["rules"]}
+    assert {"GL001", "GL101"} <= rule_ids, (
+        f"{where}: stamp rule set {sorted(rule_ids)} is missing the core or "
+        f"SPMD family — it was not produced by the full default run")
+    assert data["clean"] == (not data["violations"]), where
+
+
+def test_committed_lint_stamps_conform_to_schema():
+    import json
+
+    for stamp in sorted((REPO / "benchmarks").glob("lint_stamp*.json")):
+        _assert_stamp_schema(json.loads(stamp.read_text()), stamp.name)
+
+
+def test_lint_stamp_renderer_emits_the_pinned_schema():
+    """Non-vacuous even while no live-session stamp is committed (r6 is
+    queued): render a stamp in-process and hold it to the same schema the
+    committed ones must satisfy."""
+    import json
+
+    from matcha_tpu.analysis import ALL_RULES, lint_paths, render_json
+
+    violations, sources = lint_paths(
+        ["lint_tpu.py"], ALL_RULES, baseline=set(), repo_root=REPO)
+    data = json.loads(render_json(violations, sources, ALL_RULES))
+    _assert_stamp_schema(data, "render_json")
+    assert data["files_checked"] == 1
