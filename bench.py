@@ -544,6 +544,28 @@ def _run_bounded(cmd, env, timeout):
         return -1, out, err, True, time.time() - t0
 
 
+def _journal_record(args, record, status: str) -> None:
+    """Mirror the final bench record into a run journal (``--journal``).
+
+    The JSON line on stdout stays the driver contract; the journal copy is
+    what ``obs_tpu.py compare`` reads, so bench rounds become comparable
+    with training runs (and with each other) without scraping stdout.
+    Best-effort by design: a journal failure must never cost the record.
+    """
+    if not args.journal:
+        return
+    try:
+        from matcha_tpu.obs import append_journal_record
+
+        append_journal_record(args.journal, "bench", record=record,
+                              status=status)
+    # graftlint: disable=GL006 — the journal mirror is optional context;
+    # an unwritable path must not turn a finished measurement into rc!=0
+    except Exception as e:  # noqa: BLE001
+        print(f"# journal append failed: {type(e).__name__}: "
+              f"{str(e)[:200]}", file=sys.stderr)
+
+
 def orchestrate(args, passthrough) -> int:
     me = os.path.abspath(__file__)
     t_start = time.time()
@@ -657,6 +679,7 @@ def orchestrate(args, passthrough) -> int:
             if probes:
                 record["tunnel_probes"] = probes
             print(json.dumps(record))
+            _journal_record(args, record, "measured")
             return 0
         if record is not None and record.get("backend") != "cpu-fallback":
             # the worker died or timed out AFTER printing a real measurement
@@ -683,6 +706,7 @@ def orchestrate(args, passthrough) -> int:
         if probes:
             salvaged["tunnel_probes"] = probes
         print(json.dumps(salvaged))
+        _journal_record(args, salvaged, "salvaged")
         return 0
 
     # The TPU never produced a number: promote the provisional record, and
@@ -722,6 +746,7 @@ def orchestrate(args, passthrough) -> int:
     except Exception:  # noqa: BLE001 — the pointer is best-effort context
         pass
     print(json.dumps(provisional))
+    _journal_record(args, provisional, "cpu-fallback")
     return 0
 
 
@@ -800,6 +825,10 @@ def main():
                         "after a single timed-out attempt — the tunnel's "
                         "failure mode is intermittent, so retry while the "
                         "budget arithmetic allows)")
+    p.add_argument("--journal", default=None,
+                   help="append the final record as a `bench` event to this "
+                        "run-journal JSONL (obs_tpu.py compare reads it); "
+                        "the stdout JSON line is unchanged")
     p.add_argument("--in-process", action="store_true",
                    help="run the measurement in this process (no subprocess "
                         "shield); used internally for the worker")

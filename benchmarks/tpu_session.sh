@@ -31,6 +31,13 @@ timeout -k 10 120 python lint_tpu.py --format json > benchmarks/lint_stamp_r6.js
 timeout -k 10 120 python lint_tpu.py lint-plan \
     || echo "lint-plan: committed plan artifact(s) FAILED verification"
 
+# 0.2 obs stamp: every bench invocation this session also mirrors its
+#     final record into the session journal (bench.py --journal), so the
+#     round's numbers become `bench` events obs_tpu.py can compare against
+#     past rounds and against training-run journals.  After the captures,
+#     step 6 renders the comparison as a committable markdown artifact.
+OBS_JOURNAL=benchmarks/bench_journal_r6.jsonl
+
 # 1. THE driver artifact: per-step primary + chunked secondary + the
 #    overlap × wire-dtype grid (bench.py now emits `overlap_grid` by
 #    default: eager|1step × f32|bf16 cells with rate + bytes_per_step);
@@ -38,7 +45,7 @@ timeout -k 10 120 python lint_tpu.py lint-plan \
 #    capture_live persists an on-TPU record as bench_live_r6.json — the
 #    committed hardware evidence the fallback path cites, now carrying the
 #    combined overlap+bf16 speedup as the headline ask of this window.
-python benchmarks/capture_live.py --round 6
+python benchmarks/capture_live.py --round 6 -- --journal "$OBS_JOURNAL"
 [ "$GATE_RC" -eq 0 ] || { echo "gate failed (rc=$GATE_RC): skipping tuning steps"; exit 1; }
 
 # 1.5 overlap × wire-dtype at the *training* regime: the pipelined train
@@ -129,3 +136,10 @@ timeout -k 30 1200 python benchmarks/budget_sweep.py --reps 2
 
 # 5. refresh the skip microbench (masked-control discipline)
 timeout -k 30 600 python benchmarks/skip_microbench.py
+
+# 6. obs stamp render: one table across this round's journal and every
+#    committed BENCH_r* record — the cross-round comparison obs_tpu.py
+#    compare exists for, persisted as a committable markdown artifact.
+timeout -k 10 120 python obs_tpu.py compare "$OBS_JOURNAL" BENCH_r0*.json \
+    --md benchmarks/obs_compare_r6.md \
+    || echo "obs compare: no comparable records (journal missing?)"

@@ -1,14 +1,18 @@
 #!/usr/bin/env bash
 # ci/lint.sh — the static-analysis gate (ISSUE 6).
 #
-# Three stages, each loud on failure; the gate fails if any stage fails:
+# Five stages, each loud on failure; the gate fails if any stage fails:
 #
 #   1. graftlint     GL001–GL006 (syntactic) + GL101–GL104 (SPMD dataflow)
-#                    over the shipped surface, empty baseline
+#                    over the shipped surface (incl. matcha_tpu/obs and
+#                    obs_tpu.py), empty baseline
 #   2. lint-plan     PL001–PL008 numeric verification of every committed
 #                    schedule/plan artifact under benchmarks/
 #   3. analysis lane the same engines + the dynamic retrace sanitizer +
 #                    per-rule fixtures, as pytest (marker: analysis)
+#   4. obs lane      telemetry / journal / drift tests (marker: obs)
+#   5. obs smoke     obs_tpu.py summary over the committed reference
+#                    journal — the renderer must parse what the repo ships
 #
 # Fast pre-commit variant: lint only what changed vs a ref —
 #
@@ -37,5 +41,12 @@ python lint_tpu.py lint-plan || rc=1
 echo "== analysis pytest lane =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m analysis -p no:cacheprovider || rc=1
+
+echo "== obs pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m obs -p no:cacheprovider || rc=1
+
+echo "== obs_tpu summary smoke (reference journal) =="
+python obs_tpu.py summary benchmarks/events_ring8.jsonl >/dev/null || rc=1
 
 exit $rc

@@ -91,16 +91,22 @@ class Communicator:
         advances at *issue* time, so a pipelined chain threads carries
         identically to an eager one.
         """
-        if alive is None:
-            mixed, carry = self.step(flat, carry, flags_t)
-        else:
-            mixed, carry = self.step(flat, carry, flags_t, alive)
-        return mixed - flat, carry
+        # named scope, not a wall-clock bracket: XLA fuses the exchange
+        # into the surrounding step, so attribution must ride the op
+        # metadata (utils.profiling.device_span) — every collective this
+        # phase emits shows up under comm/begin_mix in a profiler trace
+        with jax.named_scope("comm/begin_mix"):
+            if alive is None:
+                mixed, carry = self.step(flat, carry, flags_t)
+            else:
+                mixed, carry = self.step(flat, carry, flags_t, alive)
+            return mixed - flat, carry
 
     def apply_mix(self, flat: jax.Array, delta: jax.Array) -> jax.Array:
         """Consume a ``begin_mix`` delta: a pure elementwise add, no
         collectives — safe to fuse into the next step's update math."""
-        return flat + delta
+        with jax.named_scope("comm/apply_mix"):
+            return flat + delta
 
     def run_overlapped(self, flat: jax.Array, flags: jax.Array,
                        carry: Any = None, alive: Any = None,

@@ -1,0 +1,55 @@
+"""Observability: in-graph telemetry, the unified run journal, drift watch.
+
+Three layers (DESIGN.md §14), one import surface:
+
+* :mod:`telemetry` — a small ``Telemetry`` pytree carried through the
+  compiled train step that accumulates device-side counters (per-step
+  disagreement, wire bytes, matchings, alive workers, heal/quantize
+  events) with **zero extra host syncs**: it is read exactly once per
+  epoch, at the boundary where the loop already synchronizes.
+* :mod:`journal` — the schema-versioned JSONL event stream
+  (``events.jsonl``) every run writes: telemetry flushes, fault-ledger
+  events, rollbacks, α re-derivations, drift trips, checkpoint writes.
+  The Recorder's ``faults.json`` becomes a *view* of this stream.
+* :mod:`drift` — the live planner-drift monitor: measured per-epoch
+  disagreement contraction vs the plan's predicted ρ (staleness /
+  bf16-floor / fault-degraded composition from ``plan.spectral``),
+  journaling a ``drift`` event after K consecutive out-of-band epochs.
+
+``obs_tpu.py`` renders a run's journal (summary / tail / drift / compare).
+"""
+
+from .drift import DriftMonitor, compose_predicted_rho, drift_report
+from .journal import (
+    EVENT_KINDS,
+    FAULT_KINDS,
+    SCHEMA_VERSION,
+    Journal,
+    append_journal_record,
+    epoch_series,
+    make_event,
+    read_journal,
+    resolve_journal_path,
+    validate_event,
+)
+from .telemetry import Telemetry, TelemetrySpec, telemetry_flush, telemetry_step
+
+__all__ = [
+    "DriftMonitor",
+    "EVENT_KINDS",
+    "FAULT_KINDS",
+    "Journal",
+    "SCHEMA_VERSION",
+    "Telemetry",
+    "TelemetrySpec",
+    "append_journal_record",
+    "compose_predicted_rho",
+    "drift_report",
+    "epoch_series",
+    "make_event",
+    "read_journal",
+    "resolve_journal_path",
+    "telemetry_flush",
+    "telemetry_step",
+    "validate_event",
+]

@@ -1,0 +1,217 @@
+"""The unified run journal: a schema-versioned JSONL event stream.
+
+One file per run — ``events.jsonl`` next to the Recorder's CSVs — holding
+everything that used to be scattered or invisible: per-epoch telemetry
+flushes, the fault ledger (plans, heals, rollbacks, α re-derivations,
+emergency checkpoints), planner-drift trips, checkpoint writes, retrace-
+sanitizer trips, and bench records.  ``faults.json`` is still written, but
+as a *view* of this stream (``plan verify`` back-compat); the journal is
+the source of truth.
+
+Format: one JSON object per line, append-only.  Every event carries
+
+* ``v``     — schema version (this module's ``SCHEMA_VERSION``),
+* ``kind``  — one of ``EVENT_KINDS`` (unknown kinds are a validation
+  error: the committed reference journal pins the vocabulary so the
+  format cannot drift silently),
+* ``t``     — seconds since the writing process's start (standalone
+  appenders like ``bench.py --journal`` use absolute unix time).  ``t``
+  is monotone only within one process's appended segment — a resumed
+  run restarts the clock, so a resumed journal's ``t`` *drops* at the
+  resume point.  Readers must order by **line position**, never by
+  ``t`` (everything in this package does),
+
+plus kind-specific payload fields (``REQUIRED_FIELDS``).  A resumed run
+appends after the pre-crash events verbatim; replayed epochs therefore
+re-journal their telemetry — readers take the **last** event per epoch
+(:func:`latest_per_epoch`), so a journal is never rewritten, only grown.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = ["SCHEMA_VERSION", "EVENT_KINDS", "FAULT_KINDS", "REQUIRED_FIELDS",
+           "make_event", "validate_event", "Journal", "read_journal",
+           "resolve_journal_path", "latest_per_epoch", "epoch_series",
+           "append_journal_record"]
+
+SCHEMA_VERSION = 1
+
+#: Every kind a v1 journal may contain.  The five fault kinds keep their
+#: historical ``faults.json`` names so the view stays a pure filter.
+FAULT_KINDS = frozenset({
+    "plan", "healed", "rollback", "alpha_rederived", "emergency_checkpoint",
+})
+EVENT_KINDS = frozenset({
+    "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
+    "retrace", "bench",
+}) | FAULT_KINDS
+
+#: Kind-specific payload keys an event must carry to validate.  Kinds not
+#: listed need only the envelope (v / kind / t).
+REQUIRED_FIELDS: Dict[str, frozenset] = {
+    "run_start": frozenset({"config", "predicted"}),
+    "epoch": frozenset({"epoch", "epoch_time", "comp_time", "comm_time",
+                        "train_loss", "disagreement"}),
+    "telemetry": frozenset({"epoch", "steps", "disagreement_mean",
+                            "disagreement_last", "wire_bytes",
+                            "matchings_mean", "alive_mean"}),
+    "drift": frozenset({"epoch", "predicted_factor", "measured_factor",
+                        "tolerance", "streak"}),
+    "checkpoint": frozenset({"epoch", "path"}),
+    "retrace": frozenset({"label", "traces"}),
+    "bench": frozenset({"record"}),
+}
+
+
+def make_event(kind: str, t: float, **fields) -> dict:
+    """Envelope + payload.  ``t`` is the journal's run-relative clock."""
+    return {"v": SCHEMA_VERSION, "kind": kind, "t": float(t), **fields}
+
+
+def validate_event(event: dict) -> List[str]:
+    """Schema check; returns human-readable problems (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(event, dict):
+        return [f"event is {type(event).__name__}, not an object"]
+    if event.get("v") != SCHEMA_VERSION:
+        problems.append(f"v={event.get('v')!r} (want {SCHEMA_VERSION})")
+    kind = event.get("kind")
+    if kind not in EVENT_KINDS:
+        problems.append(f"unknown kind {kind!r}")
+    t = event.get("t")
+    if not isinstance(t, (int, float)) or not t >= 0:
+        problems.append(f"t={t!r} is not a non-negative number")
+    missing = REQUIRED_FIELDS.get(kind, frozenset()) - set(event)
+    if missing:
+        problems.append(f"{kind} event missing {sorted(missing)}")
+    return problems
+
+
+def _dump_line(event: dict) -> str:
+    return json.dumps(event, sort_keys=True, separators=(",", ":")) + "\n"
+
+
+class Journal:
+    """Incremental JSONL sink over an in-memory event list.
+
+    The Recorder owns the list and calls :meth:`flush` at its save cadence;
+    only events past the high-water mark are appended (O(new) per flush,
+    the same contract as the append-only CSVs).  ``rewrite=True`` truncates
+    first — a *fresh* run into a reused folder must not extend a previous
+    run's journal, exactly like the CSV truncation; a *resumed* run flushes
+    without rewrite so the pre-crash history survives verbatim.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._flushed = 0
+
+    def mark_flushed(self, count: int) -> None:
+        """Pre-crash events reloaded from disk are already on disk."""
+        self._flushed = int(count)
+
+    def flush(self, events: Sequence[dict], rewrite: bool = False) -> int:
+        """Write pending events; returns how many lines were written."""
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if rewrite:
+            self._flushed = 0
+        pending = list(events[self._flushed:])
+        if rewrite or not os.path.exists(self.path):
+            # truncate + full write: atomic via tmp so a crash mid-dump
+            # cannot leave half a journal where a whole one existed
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                for e in events:
+                    f.write(_dump_line(e))
+            os.replace(tmp, self.path)
+        elif pending:
+            with open(self.path, "a") as f:
+                for e in pending:
+                    f.write(_dump_line(e))
+        self._flushed = len(events)
+        return len(pending) if not rewrite else len(events)
+
+
+def read_journal(path: str, repair: bool = False) -> List[dict]:
+    """Parse a journal file; loud on malformed lines (line number named).
+
+    ``repair=True`` tolerates exactly one failure mode: a malformed
+    **final** line — the partial tail a crash mid-append leaves behind
+    (the append path cannot be atomic the way the rewrite path is).  The
+    truncated tail is dropped and the parsed prefix returned; a malformed
+    line anywhere *else* is real corruption and still raises.  A caller
+    that repairs must not blindly append after the broken tail (the file
+    would then be broken mid-stream forever) — ``Recorder.load_previous``
+    schedules a full rewrite when the parsed count disagrees with the
+    file (see there).
+    """
+    events: List[dict] = []
+    lines = []
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            if raw.strip():
+                lines.append((lineno, raw.strip()))
+    for i, (lineno, line) in enumerate(lines):
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if repair and i == len(lines) - 1:
+                break  # crash-truncated tail: drop it, keep the prefix
+            raise ValueError(f"{path}:{lineno}: malformed journal line "
+                             f"({e})") from e
+    return events
+
+
+def resolve_journal_path(source: str) -> str:
+    """A run directory (holding ``events.jsonl``) or a journal file path."""
+    if os.path.isdir(source):
+        path = os.path.join(source, "events.jsonl")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{source} holds no events.jsonl — was the run saved with "
+                f"telemetry on (TrainConfig.save / --save)?")
+        return path
+    if not os.path.exists(source):
+        raise FileNotFoundError(f"no journal at {source}")
+    return source
+
+
+def latest_per_epoch(events: Iterable[dict], kind: str) -> Dict[int, dict]:
+    """``{epoch: event}`` keeping the **last** event per epoch — the replay
+    rule for resumed runs (the journal is append-only; a re-run epoch's
+    newer event supersedes the stale one)."""
+    out: Dict[int, dict] = {}
+    for e in events:
+        if e.get("kind") == kind and "epoch" in e:
+            out[int(e["epoch"])] = e
+    return out
+
+
+def epoch_series(events: Iterable[dict], kind: str, field: str,
+                 default: Optional[float] = None):
+    """``(epochs, values)`` for one field of one kind, epoch-deduplicated
+    and epoch-sorted — what the drift analyzer and the renderers consume."""
+    latest = latest_per_epoch(events, kind)
+    epochs = sorted(latest)
+    values = [latest[e].get(field, default) for e in epochs]
+    return epochs, values
+
+
+def append_journal_record(path: str, kind: str, **fields) -> dict:
+    """One-shot appender for standalone emitters (``bench.py --journal``,
+    session stamps): no Recorder, no run clock — ``t`` is absolute unix
+    time, monotone within the file like any run journal.  Returns the
+    event written."""
+    event = make_event(kind, time.time(), **fields)
+    problems = validate_event(event)
+    if problems:
+        raise ValueError(f"refusing to journal invalid event: {problems}")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(_dump_line(event))
+    return event

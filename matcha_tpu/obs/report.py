@@ -1,0 +1,266 @@
+"""Render a run journal into terminal text / a markdown artifact.
+
+Pure formatting — every number comes from the journal; nothing here
+recomputes physics (that is :mod:`drift`'s job).  The markdown output is
+the committable artifact (``obs_tpu.py summary --md``): the same table the
+terminal shows, in a form a PR or a session log can embed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["summarize", "render_summary", "render_tail", "render_compare",
+           "compare_sources"]
+
+_SI = ((1e12, "TB"), (1e9, "GB"), (1e6, "MB"), (1e3, "kB"))
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    for scale, unit in _SI:
+        if abs(n) >= scale:
+            return f"{n / scale:.2f} {unit}"
+    return f"{n:.0f} B"
+
+
+def _fmt(v, digits=4) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def summarize(events: List[dict]) -> Dict:
+    """Digest a journal into the structure both renderers share."""
+    from .journal import FAULT_KINDS, latest_per_epoch
+
+    start = next((e for e in events if e.get("kind") == "run_start"), None)
+    tel = latest_per_epoch(events, "telemetry")
+    ep = latest_per_epoch(events, "epoch")
+    epochs = sorted(set(tel) | set(ep))
+    rows = []
+    for e in epochs:
+        t, p = tel.get(e, {}), ep.get(e, {})
+        rows.append({
+            "epoch": e,
+            "loss": p.get("train_loss"),
+            "acc": p.get("train_acc"),
+            "disagreement": t.get("disagreement_mean", p.get("disagreement")),
+            "wire_bytes": t.get("wire_bytes"),
+            "matchings": t.get("matchings_mean"),
+            "alive_min": t.get("alive_min"),
+            "healed": t.get("healed"),
+            "epoch_time": p.get("epoch_time"),
+            "comm_time": p.get("comm_time"),
+        })
+    faults = [e for e in events if e.get("kind") in FAULT_KINDS]
+    drift = [e for e in events if e.get("kind") == "drift"]
+    retrace = [e for e in events if e.get("kind") == "retrace"]
+    bench = [e for e in events if e.get("kind") == "bench"]
+    total_bytes = sum(r["wire_bytes"] or 0.0 for r in rows) or None
+    return {
+        "start": start,
+        "rows": rows,
+        "faults": faults,
+        "drift": drift,
+        "retrace": retrace,
+        "bench": bench,
+        "total_wire_bytes": total_bytes,
+        "events_total": len(events),
+    }
+
+
+def _header_lines(digest: Dict, source: str) -> List[str]:
+    lines = [f"run journal: {source} ({digest['events_total']} events)"]
+    start = digest["start"]
+    if start:
+        cfg = start.get("config", {})
+        pred = start.get("predicted", {})
+        lines.append(
+            "  config: "
+            + ", ".join(f"{k}={cfg[k]}" for k in
+                        ("name", "model", "dataset", "num_workers", "budget",
+                         "communicator", "overlap", "wire_dtype")
+                        if k in cfg))
+        if pred:
+            lines.append(
+                f"  plan: rho={_fmt(pred.get('rho'))} "
+                f"(base {_fmt(pred.get('rho_base'))}), "
+                f"steps/epoch={pred.get('steps_per_epoch', '-')}, "
+                f"drift band=x{_fmt(1.0 + pred.get('tolerance', 0.25), 3)} "
+                f"over {pred.get('patience', '-')} epochs")
+    return lines
+
+
+def render_summary(events: List[dict], source: str = "events.jsonl") -> str:
+    digest = summarize(events)
+    lines = _header_lines(digest, source)
+    rows = digest["rows"]
+    if rows:
+        lines.append("")
+        lines.append(f"{'epoch':>5} {'loss':>9} {'disagree':>10} "
+                     f"{'wire':>10} {'match':>6} {'alive':>6} {'heal':>5} "
+                     f"{'t[s]':>7} {'comm[s]':>8}")
+        for r in rows:
+            lines.append(
+                f"{r['epoch']:>5} {_fmt(r['loss']):>9} "
+                f"{_fmt(r['disagreement']):>10} "
+                f"{_fmt_bytes(r['wire_bytes']):>10} "
+                f"{_fmt(r['matchings'], 3):>6} {_fmt(r['alive_min'], 3):>6} "
+                f"{_fmt(r['healed'], 3):>5} {_fmt(r['epoch_time'], 3):>7} "
+                f"{_fmt(r['comm_time'], 3):>8}")
+        lines.append(f"total wire bytes: "
+                     f"{_fmt_bytes(digest['total_wire_bytes'])}")
+    for label, key in (("fault events", "faults"), ("drift events", "drift"),
+                       ("retrace events", "retrace")):
+        if digest[key]:
+            lines.append(f"{label}: {len(digest[key])}")
+            for e in digest[key]:
+                detail = {k: v for k, v in e.items()
+                          if k not in ("v", "t", "kind")}
+                lines.append(f"  t={e.get('t', 0):.1f}s {e['kind']}: "
+                             f"{json.dumps(detail, sort_keys=True)[:160]}")
+    if digest["bench"]:
+        lines.append(f"bench records: {len(digest['bench'])}")
+    return "\n".join(lines)
+
+
+def render_summary_markdown(events: List[dict],
+                            source: str = "events.jsonl") -> str:
+    digest = summarize(events)
+    lines = [f"# Run journal — {os.path.basename(source)}", ""]
+    for h in _header_lines(digest, source)[1:]:
+        lines.append(f"- {h.strip()}")
+    rows = digest["rows"]
+    if rows:
+        lines += ["",
+                  "| epoch | loss | disagreement | wire | matchings "
+                  "| alive_min | healed | epoch s | comm s |",
+                  "|---:|---:|---:|---:|---:|---:|---:|---:|---:|"]
+        for r in rows:
+            lines.append(
+                f"| {r['epoch']} | {_fmt(r['loss'])} "
+                f"| {_fmt(r['disagreement'])} "
+                f"| {_fmt_bytes(r['wire_bytes'])} | {_fmt(r['matchings'], 3)} "
+                f"| {_fmt(r['alive_min'], 3)} | {_fmt(r['healed'], 3)} "
+                f"| {_fmt(r['epoch_time'], 3)} | {_fmt(r['comm_time'], 3)} |")
+        lines.append("")
+        lines.append(f"Total wire bytes: "
+                     f"**{_fmt_bytes(digest['total_wire_bytes'])}**")
+    for label, key in (("Fault", "faults"), ("Drift", "drift"),
+                       ("Retrace", "retrace")):
+        if digest[key]:
+            lines += ["", f"## {label} events", ""]
+            for e in digest[key]:
+                detail = {k: v for k, v in e.items()
+                          if k not in ("v", "t", "kind")}
+                lines.append(f"- `t={e.get('t', 0):.1f}s` **{e['kind']}** "
+                             f"`{json.dumps(detail, sort_keys=True)[:200]}`")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_tail(events: List[dict], n: int = 20) -> str:
+    lines = []
+    for e in events[-n:]:
+        detail = {k: v for k, v in e.items() if k not in ("v", "t", "kind")}
+        lines.append(f"t={e.get('t', 0):>8.1f}s  {e.get('kind', '?'):<22} "
+                     f"{json.dumps(detail, sort_keys=True)[:140]}")
+    return "\n".join(lines) if lines else "(empty journal)"
+
+
+def _bench_row(label: str, record: Dict) -> Dict:
+    return {
+        "source": label,
+        "value": record.get("value"),
+        "unit": record.get("unit"),
+        "backend": record.get("backend"),
+        "vs_baseline": record.get("vs_baseline"),
+        "device_kind": record.get("device_kind"),
+        "mfu": record.get("mfu"),
+    }
+
+
+def compare_sources(sources: Sequence[str]) -> Tuple[List[Dict], List[str]]:
+    """Rows for ``obs_tpu.py compare`` from heterogeneous sources.
+
+    Accepts run dirs / journal files (``bench`` events and the last
+    telemetry flush become rows) and bare ``BENCH_r*.json`` records (the
+    pre-journal capture format) — so rounds before and after the journal
+    existed land in one table.  Returns ``(rows, problems)``; unreadable
+    sources are reported, not fatal (a comparison that dies on one bad
+    file helps nobody mid-session).
+    """
+    from .journal import read_journal, resolve_journal_path
+
+    rows: List[Dict] = []
+    problems: List[str] = []
+    for src in sources:
+        label = os.path.basename(src.rstrip("/")) or src
+        try:
+            if src.endswith(".json"):
+                with open(src) as f:
+                    rec = json.load(f)
+                # unwrap the known capture formats: bench_live_r*.json
+                # ({"record": ...}) and the driver's BENCH_r*.json
+                # ({"parsed": ...} with the raw line in "tail")
+                rec = rec.get("record", rec)
+                rec = rec.get("parsed") or rec
+                if "value" not in rec and isinstance(rec.get("tail"), str):
+                    try:
+                        rec = json.loads(rec["tail"].strip().splitlines()[-1])
+                    except (json.JSONDecodeError, IndexError):
+                        pass
+                rows.append(_bench_row(label, rec))
+                continue
+            events = read_journal(resolve_journal_path(src))
+            bench = [e for e in events if e.get("kind") == "bench"]
+            if bench:
+                for i, e in enumerate(bench):
+                    tag = e.get("round", i + 1)
+                    rows.append(_bench_row(f"{label}#{tag}",
+                                           e.get("record", {})))
+            else:
+                digest = summarize(events)
+                last = digest["rows"][-1] if digest["rows"] else {}
+                rows.append({
+                    "source": label,
+                    "value": last.get("disagreement"),
+                    "unit": "disagreement_rms",
+                    "backend": (digest["start"] or {}).get(
+                        "config", {}).get("communicator"),
+                    "vs_baseline": None,
+                    "device_kind": None,
+                    "mfu": None,
+                    "wire_bytes": digest["total_wire_bytes"],
+                })
+        except (OSError, ValueError, KeyError) as e:
+            problems.append(f"{src}: {type(e).__name__}: {e}")
+    return rows, problems
+
+
+def render_compare(rows: List[Dict], problems: List[str],
+                   markdown: bool = False) -> str:
+    cols = ("source", "value", "unit", "backend", "vs_baseline",
+            "device_kind", "mfu")
+    if markdown:
+        lines = ["| " + " | ".join(cols) + " |",
+                 "|" + "|".join("---" for _ in cols) + "|"]
+        for r in rows:
+            lines.append("| " + " | ".join(_fmt(r.get(c)) for c in cols)
+                         + " |")
+    else:
+        widths = {c: max(len(c), *(len(_fmt(r.get(c))) for r in rows))
+                  if rows else len(c) for c in cols}
+        lines = [" ".join(c.ljust(widths[c]) for c in cols)]
+        for r in rows:
+            lines.append(" ".join(_fmt(r.get(c)).ljust(widths[c])
+                                  for c in cols))
+    for p in problems:
+        lines.append(f"# unreadable: {p}")
+    return "\n".join(lines)

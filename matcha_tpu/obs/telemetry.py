@@ -1,0 +1,178 @@
+"""In-graph step telemetry: device-side counters, host reads at epoch edges.
+
+The reference's only step-level signal is a wall-clock bracket around MPI
+calls; under XLA that boundary does not exist (the gossip is fused into the
+step), and any per-step host read would serialize the pipelined dispatch
+the scanned epoch exists to provide.  The contract here:
+
+* ``Telemetry`` is a pytree of **scalars** threaded through the compiled
+  step exactly like the rest of ``TrainState`` — accumulation is a handful
+  of adds fused into the program, so the hot path pays nothing observable.
+* The host reads it only at the epoch flush (``telemetry_flush``), at the
+  boundary where ``train/loop.py`` already calls ``block_until_ready`` —
+  zero *extra* host syncs, which is what keeps graftlint GL002 (host
+  impurity under jit) structurally satisfiable: nothing in this module
+  touches the host from traced code.
+* Static per-run facts (bytes a matching moves at the configured wire
+  dtype, whether the wire quantizes, whether the pipeline is on) are baked
+  into a ``TelemetrySpec`` at step-build time, so the in-graph work is a
+  dot product with a constant vector, not a recomputation.
+
+Wire-byte model: the dense row-exchange account of
+``parallel.gossip.matching_wire_bytes`` — 2·E_j·D values per fired matching
+at the wire dtype's width.  CHOCO's compressed stream is *not* modeled
+(the counter reports the uncompressed equivalent; documented limit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+__all__ = ["Telemetry", "TelemetrySpec", "make_telemetry_spec",
+           "telemetry_step", "telemetry_flush"]
+
+
+class Telemetry(struct.PyTreeNode):
+    """Device-side per-epoch accumulator (all leaves f32 scalars).
+
+    ``alive_min`` starts at ``+inf`` so the running ``minimum`` is exact
+    from the first step; ``telemetry_flush`` maps a still-infinite value
+    (an epoch of zero steps) to NaN rather than inventing a fleet size.
+    """
+
+    steps: jax.Array              # gossip/train steps accumulated
+    disagreement_sum: jax.Array   # Σ per-step RMS consensus error
+    disagreement_last: jax.Array  # the last step's RMS consensus error
+    wire_bytes: jax.Array         # Σ bytes-on-wire (wire-dtype aware)
+    matchings: jax.Array          # Σ activated matchings
+    alive_sum: jax.Array          # Σ alive-worker count (N when fault-free)
+    alive_min: jax.Array          # min alive-worker count over the window
+    stale_steps: jax.Array        # steps that consumed a one-step-stale mix
+    stale_dropped: jax.Array      # pending deltas dropped at heal (rows)
+    quantized_values: jax.Array   # values rounded through a narrow wire
+    healed: jax.Array             # rows healed from the survivor mean
+
+    @classmethod
+    def zeros(cls) -> "Telemetry":
+        # one fresh buffer per field: the scanned epoch *donates* the
+        # state, and donation rejects the same buffer appearing twice —
+        # a single shared zeros() would alias every leaf
+        def z():
+            return jnp.zeros((), jnp.float32)
+
+        return cls(steps=z(), disagreement_sum=z(), disagreement_last=z(),
+                   wire_bytes=z(), matchings=z(), alive_sum=z(),
+                   alive_min=jnp.asarray(jnp.inf, jnp.float32),
+                   stale_steps=z(), stale_dropped=z(), quantized_values=z(),
+                   healed=z())
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetrySpec:
+    """Trace-time constants the in-graph update closes over.
+
+    ``wire_bytes_per_matching``/``wire_values_per_matching``: f32[M] — what
+    one firing of matching j moves at the configured wire dtype (bytes) and
+    how many values it rounds (0-cost to carry both; the quantize counter
+    needs values, the byte counter needs bytes).  ``quantizing`` is True
+    when the wire dtype is narrower than f32; ``overlap`` when the
+    pipelined (one-step-stale) schedule runs.
+    """
+
+    wire_bytes_per_matching: np.ndarray
+    wire_values_per_matching: np.ndarray
+    quantizing: bool
+    overlap: bool
+
+
+def make_telemetry_spec(decomposed: Sequence[Sequence[tuple]], dim: int,
+                        wire_dtype=None, overlap: str = "off") -> TelemetrySpec:
+    """Bake a schedule's static exchange accounting into a spec.
+
+    ``decomposed``: the schedule's matchings (edge lists); ``dim`` the flat
+    parameter dimension; ``wire_dtype``/``overlap`` the run's knobs.
+    """
+    from ..parallel.gossip import matching_wire_bytes, resolve_wire_dtype
+
+    wire = resolve_wire_dtype(wire_dtype)
+    bytes_el = 4 if wire is None else jnp.dtype(wire).itemsize
+    # one source of truth for the exchange model: the values vector is the
+    # byte vector divided by the element width, never a re-derivation
+    bytes_vec = np.asarray(matching_wire_bytes(decomposed, dim, wire_dtype),
+                           np.float32)
+    return TelemetrySpec(
+        wire_bytes_per_matching=bytes_vec,
+        wire_values_per_matching=bytes_vec / np.float32(bytes_el),
+        quantizing=bytes_el < 4,
+        overlap=overlap == "1step",
+    )
+
+
+def telemetry_step(
+    tel: Telemetry,
+    spec: TelemetrySpec,
+    *,
+    disagreement: jax.Array,
+    flags_t: jax.Array,
+    alive_count: jax.Array,
+    healed: Optional[jax.Array] = None,
+    stale_dropped: Optional[jax.Array] = None,
+) -> Telemetry:
+    """One step's accumulation — pure jnp, fused into the compiled step.
+
+    ``flags_t: f32[M]`` is this step's activation row; the wire accounting
+    is a dot with the spec's static per-matching vectors.  ``healed`` /
+    ``stale_dropped`` are this step's heal counts (None when the fault
+    machinery is off — compiles the zero-cost path).
+    """
+    one = jnp.ones((), jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    wire_bytes = jnp.dot(flags_t, jnp.asarray(spec.wire_bytes_per_matching))
+    wire_values = jnp.dot(flags_t, jnp.asarray(spec.wire_values_per_matching))
+    return tel.replace(
+        steps=tel.steps + one,
+        disagreement_sum=tel.disagreement_sum + disagreement,
+        disagreement_last=disagreement,
+        wire_bytes=tel.wire_bytes + wire_bytes,
+        matchings=tel.matchings + jnp.sum(flags_t),
+        alive_sum=tel.alive_sum + alive_count,
+        alive_min=jnp.minimum(tel.alive_min, alive_count),
+        stale_steps=tel.stale_steps + (one if spec.overlap else zero),
+        stale_dropped=tel.stale_dropped
+        + (stale_dropped if stale_dropped is not None else zero),
+        quantized_values=tel.quantized_values
+        + (wire_values if spec.quantizing else zero),
+        healed=tel.healed + (healed if healed is not None else zero),
+    )
+
+
+def telemetry_flush(tel: Any) -> Dict[str, float]:
+    """Read an epoch's accumulator on the host (the one sanctioned read).
+
+    Called from the train loop *after* its epoch-boundary
+    ``block_until_ready`` — the transfer rides the sync that already
+    happens.  Returns plain floats; derived means guard the zero-step
+    epoch, and a never-updated ``alive_min`` (``+inf``) reports as NaN.
+    """
+    steps = float(np.asarray(tel.steps))
+    denom = max(steps, 1.0)
+    alive_min = float(np.asarray(tel.alive_min))
+    return {
+        "steps": steps,
+        "disagreement_mean": float(np.asarray(tel.disagreement_sum)) / denom,
+        "disagreement_last": float(np.asarray(tel.disagreement_last)),
+        "wire_bytes": float(np.asarray(tel.wire_bytes)),
+        "matchings_mean": float(np.asarray(tel.matchings)) / denom,
+        "alive_mean": float(np.asarray(tel.alive_sum)) / denom,
+        "alive_min": alive_min if np.isfinite(alive_min) else float("nan"),
+        "stale_steps": float(np.asarray(tel.stale_steps)),
+        "stale_dropped": float(np.asarray(tel.stale_dropped)),
+        "quantized_values": float(np.asarray(tel.quantized_values)),
+        "healed": float(np.asarray(tel.healed)),
+    }

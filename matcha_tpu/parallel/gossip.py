@@ -64,6 +64,7 @@ __all__ = [
     "gossip_mix_skip",
     "gossip_mix_dense",
     "masked_laplacians",
+    "matching_wire_bytes",
     "dense_gossip_fn",
     "FoldedPlan",
     "build_folded_plan",
@@ -107,6 +108,26 @@ def mxu_precision(compute_dtype) -> lax.Precision:
     """
     return (lax.Precision.HIGHEST
             if jnp.dtype(compute_dtype).itemsize >= 4 else lax.Precision.DEFAULT)
+
+
+def matching_wire_bytes(decomposed, dim: int, wire_dtype=None) -> np.ndarray:
+    """``f64[M]`` — bytes that cross the wire when matching ``j`` fires.
+
+    The dense row-exchange account every backend realizes one way or
+    another: each of matching ``j``'s ``E_j`` edges moves both endpoint
+    rows (``2·E_j·dim`` values) at the wire dtype's width — the quantity
+    the telemetry layer accumulates per step (``obs.telemetry``) and the
+    roofline model prices per chain (``bench.roofline``).  Static numpy:
+    the per-matching vector is baked into the compiled step as a constant,
+    so the in-graph byte counter is one dot product with the flag row.
+    CHOCO's *compressed* stream is deliberately not modeled here (the
+    counter reports the uncompressed equivalent; the encode side is the
+    comm-split timer's job).
+    """
+    dt = resolve_wire_dtype(wire_dtype)
+    itemsize = 4 if dt is None else jnp.dtype(dt).itemsize
+    return np.asarray([2.0 * len(m) * dim * itemsize for m in decomposed],
+                      np.float64)
 
 
 def _rows(mask: jax.Array, x: jax.Array) -> jax.Array:

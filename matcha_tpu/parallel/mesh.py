@@ -88,8 +88,14 @@ def shard_workers(x, mesh: Mesh, axis: str = WORKER_AXIS):
     def put(a):
         if getattr(a, "ndim", 0) == 0 or _is_prng_key_leaf(a, mesh.shape[axis]):
             return jax.device_put(a, NamedSharding(mesh, P()))
-        spec = P(axis, *([None] * (a.ndim - 1)))
-        return jax.device_put(a, NamedSharding(mesh, spec))
+        # canonical spec: NO trailing Nones.  P(axis, None, None) and
+        # P(axis) describe the same placement but compare unequal in the
+        # jit cache key, so a state placed with the padded spec missed the
+        # cache against the compiled epoch's own outputs (short spec) and
+        # silently recompiled the entire epoch program at epoch 1 on every
+        # mesh run — one full wasted XLA compile, invisible until the obs
+        # retrace watch journaled it (tests/test_obs.py pins cache_size).
+        return jax.device_put(a, NamedSharding(mesh, P(axis)))
 
     return jax.tree_util.tree_map(put, x)
 

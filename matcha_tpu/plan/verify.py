@@ -29,7 +29,13 @@ __all__ = ["load_fault_ledger", "load_recorder_disagreement",
 
 
 def load_fault_ledger(run_dir: str) -> Optional[Dict]:
-    """Read the Recorder's ``faults.json`` ledger, if the run wrote one.
+    """Read the run's fault ledger, if it wrote one.
+
+    Source order: ``faults.json`` (the historical view the Recorder still
+    emits), falling back to the unified journal ``events.jsonl`` — the two
+    carry the same events since the Recorder refactored onto the journal
+    (ISSUE 7), but a journal-only run dir (a hand-pruned artifact, or a
+    future Recorder that drops the view) must stay verifiable.
 
     Returns a ``plan``-entry degradation summary
     (``expected_alive``/``expected_link_up``) when present — what the
@@ -41,11 +47,18 @@ def load_fault_ledger(run_dir: str) -> Optional[Dict]:
     whose array shapes disagree fall back to the last (most recent) entry.
     """
     path = os.path.join(run_dir, "faults.json")
-    if not os.path.exists(path):
-        return None
-    with open(path) as f:
-        ledger = json.load(f)
-    plans = [e for e in ledger.get("events", []) if e.get("kind") == "plan"]
+    if os.path.exists(path):
+        with open(path) as f:
+            ledger = json.load(f)
+        events = ledger.get("events", [])
+    else:
+        journal = os.path.join(run_dir, "events.jsonl")
+        if not os.path.exists(journal):
+            return None
+        from ..obs.journal import read_journal
+
+        events = read_journal(journal)
+    plans = [e for e in events if e.get("kind") == "plan"]
     if not plans:
         return None
     if len(plans) == 1:

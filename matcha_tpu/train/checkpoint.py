@@ -70,6 +70,12 @@ def _sidecar_path(directory: str, epoch: int) -> str:
 
 def save_checkpoint(directory: str, state: TrainState, epoch: int,
                     schedule=None) -> None:
+    # telemetry is per-epoch scratch (DESIGN.md §14) and is stripped HERE,
+    # not at call sites: checkpoint pytrees must be identical with
+    # telemetry on or off, and an invariant every caller has to remember
+    # is an invariant that eventually breaks.  restore_checkpoint strips
+    # its template symmetrically.
+    state = state.replace(telemetry=())
     mgr = _manager(directory)
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
@@ -124,33 +130,51 @@ def restore_checkpoint(directory: str, template: TrainState,
     step = epoch if epoch is not None else mgr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {directory}")
+    # telemetry is per-epoch scratch and is NEVER persisted (the train loop
+    # strips it on save) — strip it from any template here too, so a caller
+    # holding a live state restores cleanly, and pass the caller's own
+    # accumulator back through unchanged
+    caller_telemetry = template.telemetry
+    template = template.replace(telemetry=())
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
     try:
         state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     except ValueError as e:
-        # Legacy (pre-PR4) checkpoint: the saved tree predates
-        # TrainState.mix_pending, so orbax raises `Dict key mismatch` against
-        # any template that carries the slot (both the array and `()` forms —
-        # ROADMAP PR-5 finding).  Restore through a mix_pending-free template
-        # and re-attach the empty slot: a checkpoint written before the
-        # overlapped pipeline existed truthfully carries no in-flight delta,
-        # and `_reconcile_mix_pending` in train/loop.py primes a zero delta
-        # if this run resumes with --overlap 1step.
+        # Older checkpoint generations miss fields added since they were
+        # written, and orbax raises `Dict key mismatch` against any template
+        # that carries the extra slot (even an empty `()` one — the field
+        # name is still a dict key).  Retry through progressively older
+        # templates, newest plausible first:
+        #   1. minus `telemetry` (PR4–PR6: has mix_pending, pre-obs) — the
+        #      slot is per-epoch scratch that is never persisted anyway;
+        #   2. minus `telemetry` and `mix_pending` (pre-PR4 legacy): a
+        #      checkpoint from before the overlapped pipeline truthfully
+        #      carries no in-flight delta, and `_reconcile_mix_pending` in
+        #      train/loop.py primes a zero delta if this run resumes with
+        #      --overlap 1step (ROADMAP PR-5 finding).
         if "mismatch" not in str(e).lower():
             raise
-        legacy_abstract = {
-            f.name: getattr(abstract, f.name)
-            for f in dataclasses.fields(template)
-            if f.name != "mix_pending"
-        }
-        try:
-            restored = mgr.restore(
-                step, args=ocp.args.StandardRestore(legacy_abstract))
-        except Exception:
+        fields = {f.name: getattr(abstract, f.name)
+                  for f in dataclasses.fields(template)}
+        state = None
+        for drop in (("telemetry",), ("telemetry", "mix_pending")):
+            older = {k: v for k, v in fields.items() if k not in drop}
+            try:
+                restored = mgr.restore(
+                    step, args=ocp.args.StandardRestore(older))
+            # graftlint: disable=GL006 — each ladder rung falls through to
+            # the next; the original error is re-raised below if none fit
+            except Exception:  # noqa: BLE001
+                continue
+            state = template.replace(
+                **restored,
+                **({"mix_pending": ()} if "mix_pending" in drop else {}))
+            break
+        if state is None:
             mgr.close()
-            raise e  # not the legacy shape either: the original error names
-            # the real mismatch
-        state = template.replace(**restored, mix_pending=())
+            raise e  # none of the known generations: the original error
+            # names the real mismatch
+    state = state.replace(telemetry=caller_telemetry)
     mgr.close()
     if schedule is not None:
         cursor = int(np.asarray(state.step))

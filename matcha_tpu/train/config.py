@@ -112,6 +112,35 @@ class TrainConfig:
     max_recoveries: int = 0
     recovery_lr_backoff: float = 0.5
 
+    # observability (DESIGN.md §14).  telemetry=True threads the
+    # obs.Telemetry scalar accumulator through the compiled step (a handful
+    # of fused adds, read once per epoch — no per-step host sync) and arms
+    # the drift monitor + retrace watch.  The unified events.jsonl journal
+    # is a Recorder feature and rides save=True regardless — with telemetry
+    # off it still records run_start/epoch/fault/checkpoint events, just no
+    # telemetry flushes or drift trips.
+    telemetry: bool = True
+    # drift monitor: journal a `drift` event when the measured per-epoch
+    # disagreement contraction exceeds the plan's predicted factor
+    # (rho^(steps/2), staleness/wire/fault-composed) by more than
+    # drift_tolerance for drift_patience consecutive falsifiable epochs.
+    # Runs only for the decen communicator (the one the spectral model
+    # describes); telemetry=False disables it too.
+    drift_tolerance: float = 0.25
+    drift_patience: int = 2
+    # initial-consensus sync (reference train_mpi.py:97 sync_allreduce).
+    # False starts the workers at their independent inits — the
+    # consensus-dominant regime drift diagnostics and pure-gossip studies
+    # need (disagreement then *contracts* from a visible spread instead of
+    # rising from zero toward the gradient-drift floor).
+    sync_init: bool = True
+    # deliberate mis-plan knob (chaos testing the drift monitor): execute
+    # the schedule with this α while the drift monitor keeps comparing
+    # against the *solved* α's predicted rho — exactly the "planner claimed
+    # a contraction the runtime doesn't deliver" failure the monitor
+    # exists to catch.  None = run the solved α (always, outside tests).
+    alpha_override: Optional[float] = None
+
     # execution
     # memory/FLOPs trades for many-workers-per-chip folding (both exact):
     remat: bool = False  # block-level activation rematerialization
@@ -167,6 +196,15 @@ class TrainConfig:
                 "communicator (the only compressed one)")
         if self.max_recoveries < 0:
             raise ValueError("max_recoveries must be >= 0")
+        if not self.drift_tolerance > 0:
+            raise ValueError(
+                f"drift_tolerance must be > 0, got {self.drift_tolerance}")
+        if self.drift_patience < 1:
+            raise ValueError(
+                f"drift_patience must be >= 1, got {self.drift_patience}")
+        if self.alpha_override is not None and not self.alpha_override > 0:
+            raise ValueError(
+                f"alpha_override must be > 0, got {self.alpha_override}")
         if self.max_recoveries and not self.halt_on_divergence:
             raise ValueError(
                 "max_recoveries needs halt_on_divergence=True — recovery is "

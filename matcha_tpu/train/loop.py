@@ -28,6 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..communicator import select_communicator
+from ..obs import DriftMonitor, Telemetry, compose_predicted_rho
+from ..obs.telemetry import make_telemetry_spec, telemetry_flush
+from ..utils import annotate
 from ..data import (
     WorkerBatches,
     load_npz,
@@ -146,6 +149,16 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
     schedule = build_schedule(config, total_steps + 1)
 
+    # the *plan's* α — what the drift monitor predicts with.  alpha_override
+    # executes a deliberately different α (the mis-plan chaos knob,
+    # DESIGN.md §14): the prediction keeps the solved α, so the monitor
+    # sees exactly the "planner claimed a contraction the runtime doesn't
+    # deliver" discrepancy it exists to catch.
+    plan_alpha = float(schedule.alpha)
+    if config.alpha_override is not None:
+        schedule = dataclasses.replace(
+            schedule, alpha=float(config.alpha_override))
+
     # runtime fault plan (DESIGN.md §8): compiled against this schedule's
     # horizon into static alive/nan/link arrays, exactly like the flags.
     # Link outages fold into the flag stream right here — a severed link is
@@ -212,7 +225,34 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     state, flattener = init_train_state(
         model, input_shape, config.num_workers, optimizer, communicator,
         seed=config.seed, overlap=config.overlap,
+        sync_init=config.sync_init,
     )
+
+    # in-graph telemetry (DESIGN.md §14): static per-matching exchange
+    # accounting baked into the step; the accumulator rides TrainState and
+    # is read once per epoch.  The "none" communicator moves nothing, so
+    # its byte ledger is all-zero (matchings still count — the schedule
+    # fires them, the wire just never sees them).
+    tel_spec = None
+    if config.telemetry:
+        tel_dec = (schedule.decomposed if config.communicator != "none"
+                   else [[] for _ in schedule.decomposed])
+        tel_spec = make_telemetry_spec(
+            tel_dec, flattener.dim, wire_dtype=config.wire_dtype,
+            overlap=config.overlap)
+
+    def _fresh_telemetry():
+        """A new accumulator with the *state's* sharding: an unplaced
+        zeros pytree next to mesh-replicated scalars would hand the jitted
+        epoch a different input sharding and silently recompile it every
+        epoch (the retrace watch caught exactly this).  Fresh buffers each
+        time — the scanned epoch donates the state, so a reused template
+        would be invalidated by the very epoch that consumed it."""
+        tel = Telemetry.zeros()
+        return shard_workers(tel, mesh) if mesh is not None else tel
+
+    if tel_spec is not None:
+        state = state.replace(telemetry=_fresh_telemetry())
     if mesh is not None:
         state = shard_workers(state, mesh)
 
@@ -224,7 +264,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             model, optimizer, comm, flattener, run_flags,
             dropout=False, lr_schedule=lr_schedule,
             grad_chunk=config.grad_chunk, faults=faults,
-            overlap=config.overlap,
+            overlap=config.overlap, telemetry=tel_spec,
         )
 
     step_fn = None  # populated by _build_programs() below
@@ -300,11 +340,16 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         pend0 = jnp.zeros((config.num_workers, flattener.dim), jnp.float32)
         if mesh is not None:
             pend0 = shard_workers(pend0, mesh)  # match the state's sharding
+        # telemetry is never checkpointed (per-epoch scratch): the
+        # save/restore pair strips it internally, and the caller's slot
+        # passes through — re-primed fresh below either way
         state, last_epoch = restore_checkpoint(
             resume_dir, state.replace(mix_pending=pend0), schedule=schedule)
         start_epoch = last_epoch + 1
         state = _reconcile_mix_pending(state, config.overlap, communicator,
                                        flattener, config.num_workers)
+        if tel_spec is not None:
+            state = state.replace(telemetry=_fresh_telemetry())
         if mesh is not None:  # reconcile may have created fresh zero rows
             state = shard_workers(state, mesh)
 
@@ -326,6 +371,51 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 expected_alive=[float(v) for v in faults.expected_alive()],
                 expected_link_up=[float(v) for v in faults.expected_link_up()],
             )
+
+    # planner-drift monitor (DESIGN.md §14): the plan's full ρ composition
+    # — solved α (NOT any override), staleness, wire quantization, fault
+    # degradation — against the measured per-epoch contraction.  Only the
+    # decen communicator is modeled by the spectral bound; CHOCO's γ-damped
+    # consensus and the centralized AllReduce are out of its scope.
+    def _compose_predicted():
+        pred = compose_predicted_rho(
+            schedule.laplacians(), schedule.probs, plan_alpha,
+            overlap=config.overlap, wire_dtype=config.wire_dtype,
+            worker_alive=(np.asarray(faults.expected_alive(), np.float64)
+                          if faults is not None else None),
+            link_up=(np.asarray(faults.expected_link_up(), np.float64)
+                     if faults is not None else None),
+        )
+        pred.update(steps_per_epoch=int(bpe),
+                    tolerance=float(config.drift_tolerance),
+                    patience=int(config.drift_patience),
+                    plan_alpha=float(plan_alpha),
+                    executed_alpha=float(schedule.alpha))
+        return pred
+
+    predicted = None
+    drift_monitor = None
+    if config.telemetry and config.communicator == "decen":
+        predicted = _compose_predicted()
+        drift_monitor = DriftMonitor(
+            predicted["rho"], int(bpe), tolerance=config.drift_tolerance,
+            patience=config.drift_patience)
+    # the run-lifecycle events ride the journal unconditionally — the
+    # journal is the Recorder's record of the run (it subsumes the fault
+    # ledger); config.telemetry gates only the in-graph accumulator, the
+    # drift monitor, and their telemetry/drift events
+    if start_epoch:
+        # a resumed run may carry a *different* config (overlap, wire,
+        # fault plan, tolerance): the live monitor predicts with the new
+        # composition, so the journal must too, or a replay would hold the
+        # post-resume epochs to the stale run_start plan
+        recorder.log_event("resume", epoch=start_epoch,
+                           config=_config_snapshot(config),
+                           predicted=predicted or {})
+    else:
+        recorder.log_event("run_start",
+                           config=_config_snapshot(config),
+                           predicted=predicted or {})
     rng = jax.random.PRNGKey(config.seed)
     history: List[Dict] = []
 
@@ -340,8 +430,31 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     alpha_rederived = False
     emergency_written = False
     snapshot = None
+    # telemetry is excluded from the divergence detector: its accumulator
+    # sums fleet metrics that may legitimately go non-finite one step
+    # before the detector's own exemption logic would excuse them (a
+    # quarantined worker's spike), and it is scratch, not model state
     finite_check = jax.jit(
-        lambda s: state_finite_rows(s, config.num_workers))
+        lambda s: state_finite_rows(s.replace(telemetry=()),
+                                    config.num_workers))
+    # retrace watch: the jitted epoch program's compile-cache size, read
+    # for free after each epoch — a growing cache after the allowed shapes
+    # (whole-epoch scan: 1; chunked scan: chunk + tail = 2) is the silent
+    # recompile failure mode the sanitizer exists for (DESIGN.md §12); it
+    # is journaled once per program instead of raising mid-run
+    _retrace_flagged: set = set()
+    _trace_allowance = (2 if config.scan_chunk else 1) if config.scan_epoch \
+        else 1
+
+    def _watch_retrace(fn):
+        if not config.telemetry or fn is None:
+            return
+        count = getattr(fn, "_cache_size", lambda: None)()
+        if count is not None and count > _trace_allowance \
+                and id(fn) not in _retrace_flagged:
+            _retrace_flagged.add(id(fn))
+            recorder.log_event("retrace", label="train_step",
+                               traces=int(count))
 
     epoch = start_epoch
     while epoch < config.epochs:
@@ -397,8 +510,9 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                     if config.save and not emergency_written and epoch > 0:
                         # last-good state, resumable with --resume
                         path = f"{config.savePath}/{config.name}_emergency"
-                        save_checkpoint(path, snapshot, epoch - 1,
-                                        schedule=schedule0)
+                        with annotate("matcha/checkpoint"):
+                            save_checkpoint(path, snapshot, epoch - 1,
+                                            schedule=schedule0)
                         emergency_written = True
                         recorder.log_fault("emergency_checkpoint",
                                            epoch=epoch, path=path)
@@ -429,12 +543,29 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                             new_alpha, new_rho = solve_mixing_weight(
                                 schedule.laplacians(), schedule.probs)
                         if abs(new_alpha - schedule.alpha) > 1e-9:
-                            recorder.log_fault(
-                                "alpha_rederived", epoch=epoch,
-                                old=float(schedule.alpha),
-                                new=float(new_alpha), rho=float(new_rho))
+                            old_alpha = float(schedule.alpha)
                             schedule = dataclasses.replace(
                                 schedule, alpha=float(new_alpha))
+                            # the re-derived α IS the plan from here on:
+                            # the drift monitor must predict with it, or
+                            # every post-recovery epoch would be scored
+                            # against a schedule that no longer runs —
+                            # and the journal must carry the re-based
+                            # prediction so `obs_tpu.py drift` replays
+                            # against the same plan the live monitor used
+                            plan_alpha = float(new_alpha)
+                            new_pred = None
+                            if drift_monitor is not None:
+                                predicted = new_pred = _compose_predicted()
+                                drift_monitor = DriftMonitor(
+                                    predicted["rho"], int(bpe),
+                                    tolerance=config.drift_tolerance,
+                                    patience=config.drift_patience)
+                            recorder.log_fault(
+                                "alpha_rederived", epoch=epoch,
+                                old=old_alpha,
+                                new=float(new_alpha), rho=float(new_rho),
+                                predicted=new_pred)
                     # rebuild the compiled programs against the updated
                     # lr_scale / α / consumed fault arrays — the same recipe
                     # setup used, so retries can never run a stale program
@@ -468,7 +599,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         comm_time = comm_encode_time = 0.0
         if e_timer is not None:
             window = run_flags[epoch * bpe : (epoch + 1) * bpe]
-            split = e_timer(state, window)
+            with annotate("matcha/comm_split_timer"):
+                split = e_timer(state, window)
             comm_time = min(split["comm_time"], epoch_time)
             # encode is a component of comm_time, never exceeding it
             comm_encode_time = min(split["comm_encode_time"], comm_time)
@@ -527,11 +659,28 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 mean_alive=float(epoch_metrics.get("alive_workers",
                                                    config.num_workers)))
 
+        if tel_spec is not None:
+            # the ONE host read of the in-graph accumulator, riding the
+            # epoch-boundary sync that already happened above; the
+            # accumulator then resets for the next epoch's window
+            tel = telemetry_flush(state.telemetry)
+            recorder.log_event("telemetry", epoch=epoch, **tel)
+            state = state.replace(telemetry=_fresh_telemetry())
+            if drift_monitor is not None:
+                drift = drift_monitor.observe(epoch,
+                                              tel["disagreement_mean"])
+                if drift is not None:
+                    recorder.log_event("drift", **drift)
+        _watch_retrace(e_scan if config.scan_epoch else e_step)
+
         if config.save and recorder.epochs_recorded % 10 == 0:
-            recorder.save()  # flush cadence parity (train_mpi.py:159-160)
+            with annotate("matcha/recorder_flush"):
+                recorder.save()  # flush cadence parity (train_mpi.py:159-160)
         if config.checkpoint_every and (epoch + 1) % config.checkpoint_every == 0:
-            save_checkpoint(f"{config.savePath}/{config.name}_ckpt", state,
-                            epoch, schedule=schedule0)
+            path = f"{config.savePath}/{config.name}_ckpt"
+            with annotate("matcha/checkpoint"):
+                save_checkpoint(path, state, epoch, schedule=schedule0)
+            recorder.log_event("checkpoint", epoch=epoch, path=path)
         epoch += 1
 
     if config.overlap == "1step":
@@ -550,8 +699,27 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
         state = _drain(state)
     if config.save:
-        recorder.save()
+        with annotate("matcha/recorder_flush"):
+            recorder.save()
     return TrainResult(state, recorder, schedule, history)
+
+
+def _config_snapshot(config: TrainConfig) -> Dict:
+    """JSON-safe view of the config for the journal's ``run_start`` event
+    (the ExpDescription's structured twin).  Non-scalar fields (a parsed
+    fault plan, dataset kwargs) are stringified rather than dropped — the
+    journal records *that* they were set even when they don't serialize."""
+    out: Dict = {}
+    for field in dataclasses.fields(config):
+        v = getattr(config, field.name)
+        if isinstance(v, (str, int, float, bool, type(None))):
+            out[field.name] = v
+        elif isinstance(v, (list, tuple)) and all(
+                isinstance(x, (str, int, float, bool)) for x in v):
+            out[field.name] = list(v)
+        else:
+            out[field.name] = str(v)
+    return out
 
 
 def _reconcile_mix_pending(state, overlap: str, communicator, flattener,

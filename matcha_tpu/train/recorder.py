@@ -1,4 +1,4 @@
-"""Per-run metric recording with reference-compatible CSV output.
+"""Per-run metric recording: reference CSVs + the unified run journal.
 
 Parity with ``Recorder`` (/root/reference/util.py:378-419): per-worker series
 written as ``dsgd-lr{lr}-budget{budget}-r{rank}-{kind}.log`` files plus an
@@ -7,32 +7,64 @@ The seven reference series (recordtime, time, comptime, commtime, acc,
 losses, tacc) are kept and an eighth — ``disagreement``, the consensus error
 the reference never measures (SURVEY.md §5.5) — is added.
 
-Two resilience extensions:
+Since ISSUE 7 the recorder is a *view* over the *unified run journal*
+(``matcha_tpu.obs.journal``): every structured happening — fault-ledger
+events, telemetry flushes, per-epoch rows, drift trips, checkpoint writes —
+is one event in ``self.events``, flushed to ``events.jsonl``.  The two
+legacy artifacts are derived from it:
 
-* a **fault ledger** — ``log_fault`` appends structured events (injected
-  faults, per-epoch heal counts, rollbacks, α re-derivations) that ``save``
-  writes as ``faults.json`` next to the CSVs; the plan verifier reads it to
-  score faulty runs against the *degraded* ρ instead of the fault-free one.
-* **resume alignment** — ``load_previous`` reads the on-disk series back
-  (truncated to the restored epoch) so a crash-resume extends the CSVs
-  instead of overwriting the pre-crash history.  (Rollback recovery needs
-  no recorder rewind: the loop detects divergence *before* the failed
-  epoch's row is added.)
+* ``faults.json`` — the fault-kind events, reshaped to the historical
+  ledger entry (``recordtime`` instead of ``t``) so ``plan verify`` and
+  every existing consumer keep working unchanged;
+* the CSVs — written **append-only**: each ``save`` emits only the rows
+  added since the last flush (O(1) per flush instead of O(epochs) — the
+  full-rewrite behavior made every flush replay the whole run), falling
+  back to a full rewrite exactly when the in-memory series and the disk
+  file may disagree (first save of a run into a possibly-stale folder, and
+  the first save after a resume reload).  The bytes written are identical
+  to a single full ``np.savetxt`` (pinned by test).
+
+Resume alignment: ``load_previous`` reads the on-disk series back
+(truncated to the restored epoch) so a crash-resume extends the CSVs
+instead of overwriting the pre-crash history, and reloads the journal
+verbatim — the journal is append-only by contract, so replayed epochs
+append *newer* events and readers take the last one per epoch.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import time
 from typing import Dict, List
 
 import numpy as np
 
+from ..obs.journal import FAULT_KINDS, Journal, make_event, read_journal
+
 __all__ = ["Recorder"]
 
 SERIES = ("recordtime", "time", "comptime", "commtime", "acc", "losses", "tacc", "disagreement")
+
+# np.savetxt's default single-column format — the append path must write
+# byte-identical lines to what a full savetxt would have produced
+_FMT = "%.18e"
+
+
+def _json_safe(value):
+    """JSON-strict payloads: non-finite floats become null (json.dumps would
+    emit the nonstandard ``NaN`` token otherwise), numpy scalars unwrap."""
+    if isinstance(value, dict):
+        return {k: _json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    if isinstance(value, (np.generic,)):
+        value = value.item()
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    return value
 
 
 class Recorder:
@@ -40,12 +72,51 @@ class Recorder:
         self.config = config
         self.num_workers = num_workers
         self.data: Dict[str, List] = {k: [] for k in SERIES}
-        self.faults: List[dict] = []
+        #: the unified journal — every structured event of the run, in order
+        self.events: List[dict] = []
         self.start = time.time()
         self.folder = os.path.join(
             config.savePath, f"{config.name}_{config.model}"
         )
+        self.journal = Journal(os.path.join(self.folder, "events.jsonl"))
+        # append-only CSV bookkeeping: rows already on disk, and whether the
+        # next save must fully rewrite (fresh run into a reused folder /
+        # post-resume truncation — the two cases disk and memory can differ)
+        self._flushed_epochs = 0
+        self._csv_rewrite = True
+        self._journal_rewrite = True
 
+    # ------------------------------------------------------------- journal
+    def log_event(self, kind: str, **detail) -> dict:
+        """Append one event to the unified journal (``obs.journal`` schema:
+        ``v``/``kind``/``t`` envelope + payload).  Everything flows through
+        here — faults, telemetry flushes, epoch rows, drift trips — so the
+        journal is the one ordered record of the run."""
+        event = make_event(kind, time.time() - self.start,
+                           **_json_safe(detail))
+        self.events.append(event)
+        return event
+
+    def log_fault(self, kind: str, **detail):
+        """Append a fault-ledger event (kind ∈ ``obs.journal.FAULT_KINDS``)
+        — journal event first, ``faults.json`` is derived at save time."""
+        self.log_event(kind, **detail)
+
+    @property
+    def faults(self) -> List[dict]:
+        """The historical fault-ledger view of the journal: fault-kind
+        events reshaped to ``{"kind", "recordtime", **detail}`` — what
+        ``faults.json`` holds and ``plan verify`` consumes."""
+        view = []
+        for e in self.events:
+            if e.get("kind") not in FAULT_KINDS:
+                continue
+            entry = {k: v for k, v in e.items() if k not in ("v", "t")}
+            entry["recordtime"] = e.get("t", 0.0)
+            view.append(entry)
+        return view
+
+    # -------------------------------------------------------------- series
     def add_epoch(
         self,
         epoch_time: float,
@@ -56,6 +127,7 @@ class Recorder:
         test_acc,
         disagreement: float,
     ):
+        epoch = self.epochs_recorded
         self.data["recordtime"].append(time.time() - self.start)
         self.data["time"].append(epoch_time)
         self.data["comptime"].append(comp_time)
@@ -64,20 +136,21 @@ class Recorder:
         self.data["losses"].append(np.asarray(train_loss))
         self.data["tacc"].append(np.asarray(test_acc))
         self.data["disagreement"].append(disagreement)
+        self.log_event(
+            "epoch", epoch=epoch, epoch_time=float(epoch_time),
+            comp_time=float(comp_time), comm_time=float(comm_time),
+            train_loss=float(np.mean(np.asarray(train_loss))),
+            train_acc=float(np.mean(np.asarray(train_acc))),
+            test_acc_mean=float(np.nanmean(np.asarray(test_acc, np.float64)))
+            if np.asarray(test_acc).size else float("nan"),
+            disagreement=float(disagreement),
+        )
 
     @property
     def epochs_recorded(self) -> int:
         return len(self.data["time"])
 
-    def log_fault(self, kind: str, **detail):
-        """Append a structured event to the fault ledger (written to
-        ``faults.json`` by ``save``).  ``kind`` ∈ {"plan", "healed",
-        "rollback", "alpha_rederived", "emergency_checkpoint", ...} — the
-        ledger is a journal, not a schema."""
-        self.faults.append(
-            {"kind": kind, "recordtime": time.time() - self.start, **detail}
-        )
-
+    # -------------------------------------------------------------- resume
     def load_previous(self, epochs: int) -> int:
         """Reload up to ``epochs`` rows of a previous run's CSVs from disk.
 
@@ -96,14 +169,40 @@ class Recorder:
         number of rows actually read from disk (0 when no logs exist).
         ``recordtime`` values are kept verbatim from the original run (they
         are offsets from *that* run's start; documented, not rewritten).
-        The fault ledger is a journal, not a per-epoch series: its
-        pre-crash events are reloaded verbatim (so a resumed chaos run's
-        ``faults.json`` keeps the full rollback/heal history) and
-        post-resume events append after them."""
-        ledger = os.path.join(self.folder, "faults.json")
-        if os.path.exists(ledger):
-            with open(ledger) as f:
-                self.faults = list(json.load(f).get("events", []))
+
+        The journal (and through it the fault ledger) is not a per-epoch
+        series: pre-crash events are reloaded **verbatim** — so a resumed
+        chaos run's journal keeps the full rollback/heal history — and
+        post-resume events append after them.  Replayed epochs journal
+        fresh ``epoch``/``telemetry`` events; readers take the last per
+        epoch (``obs.journal.latest_per_epoch``).  A resume therefore
+        never rewrites the journal file, only extends it.  Runs that
+        predate the journal are upgraded in place: a bare ``faults.json``
+        is lifted into journal events so the view round-trips.
+        """
+        jpath = self.journal.path
+        if os.path.exists(jpath):
+            # repair=True drops a crash-truncated final line; when that
+            # happened the on-disk file is longer than the parsed prefix,
+            # and appending after the broken tail would corrupt the stream
+            # mid-file — schedule a full rewrite from memory instead
+            self.events = read_journal(jpath, repair=True)
+            with open(jpath) as f:
+                disk_lines = sum(1 for line in f if line.strip())
+            if disk_lines == len(self.events):
+                self.journal.mark_flushed(len(self.events))
+                self._journal_rewrite = False
+            else:
+                self._journal_rewrite = True
+        else:
+            ledger = os.path.join(self.folder, "faults.json")
+            if os.path.exists(ledger):
+                with open(ledger) as f:
+                    for e in json.load(f).get("events", []):
+                        entry = dict(e)
+                        t = entry.pop("recordtime", 0.0)
+                        self.events.append(
+                            make_event(entry.pop("kind"), t or 0.0, **entry))
         cfg = self.config
         rows: Dict[str, List] = {k: [] for k in SERIES}
         loaded = 0
@@ -135,39 +234,62 @@ class Recorder:
                 else nan_row
             rows[kind] = rows[kind][:loaded] + [pad] * (epochs - loaded)
         self.data = rows
+        # disk may hold more rows than we kept (resume from an older
+        # checkpoint truncates) — the first post-resume save must rewrite
+        self._flushed_epochs = 0
+        self._csv_rewrite = True
         return int(loaded)
 
-    def _series_for_worker(self, kind: str, rank: int) -> np.ndarray:
+    # ---------------------------------------------------------------- save
+    def _series_for_worker(self, kind: str, rank: int,
+                           start: int = 0) -> np.ndarray:
         rows = []
-        for v in self.data[kind]:
+        for v in self.data[kind][start:]:
             arr = np.asarray(v)
             rows.append(float(arr[rank]) if arr.ndim else float(arr))
         return np.asarray(rows)
 
     def save(self):
-        """Write per-worker CSV logs + ExpDescription (util.py:398-419)."""
+        """Flush: CSV rows added since the last save (append-only), the
+        ExpDescription, the ``faults.json`` view, and the journal."""
         os.makedirs(self.folder, exist_ok=True)
         cfg = self.config
+        total = self.epochs_recorded
+        rewrite = self._csv_rewrite or total < self._flushed_epochs
+        start = 0 if rewrite else self._flushed_epochs
         for rank in range(self.num_workers):
             prefix = f"dsgd-lr{cfg.lr}-budget{cfg.budget}-r{rank}-"
             for kind in SERIES:
                 path = os.path.join(self.folder, prefix + kind + ".log")
-                np.savetxt(path, self._series_for_worker(kind, rank), delimiter=",")
+                new_rows = self._series_for_worker(kind, rank, start=start)
+                if rewrite or not os.path.exists(path):
+                    np.savetxt(path, new_rows, delimiter=",", fmt=_FMT)
+                elif len(new_rows):
+                    # byte-identical to what the full savetxt would append:
+                    # same fmt, one value per line, trailing newline
+                    with open(path, "a") as f:
+                        for v in new_rows:
+                            f.write((_FMT % v) + "\n")
+        self._flushed_epochs = total
+        self._csv_rewrite = False
         desc = os.path.join(self.folder, "ExpDescription")
         with open(desc, "w") as f:
             f.write(f"{cfg.name} {cfg.description}\n")
             for field in dataclasses.fields(cfg):
                 f.write(f"{field.name}: {getattr(cfg, field.name)}\n")
         path = os.path.join(self.folder, "faults.json")
-        if self.faults:
+        faults = self.faults
+        if faults:
             # atomic like the checkpoint sidecar: a crash mid-dump must not
             # leave truncated JSON for the verifier to choke on
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({"events": self.faults}, f, indent=1)
+                json.dump({"events": faults}, f, indent=1)
             os.replace(tmp, path)
         elif os.path.exists(path):
             # a fault-free rerun into the same folder must not leave a
             # previous run's ledger behind: plan-verify would silently score
             # this run against the stale degraded rho
             os.remove(path)
+        self.journal.flush(self.events, rewrite=self._journal_rewrite)
+        self._journal_rewrite = False

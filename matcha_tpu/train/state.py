@@ -29,9 +29,10 @@ import optax
 from flax import struct
 
 from ..communicator import Communicator
+from ..obs.telemetry import telemetry_step
 from ..ops import WorkerFlattener
 from ..parallel import allreduce_mean, worker_disagreement
-from ..utils import cross_entropy_loss, top_k_accuracy
+from ..utils import cross_entropy_loss, device_span, top_k_accuracy
 
 __all__ = ["TrainState", "init_train_state", "make_train_step", "make_eval_fn", "make_optimizer"]
 
@@ -48,6 +49,15 @@ class TrainState(struct.PyTreeNode):
     # checkpoints are unchanged.  Part of the state on purpose: the pipeline
     # survives epoch boundaries and checkpoint/resume without a re-prime.
     mix_pending: Any = ()
+    # device-side step telemetry (DESIGN.md §14): an ``obs.Telemetry``
+    # scalar pytree when observability is on, the empty tuple when off.
+    # Carried in the state so the scanned epoch accumulates it without any
+    # host round-trip; the loop reads it exactly once per epoch (at the
+    # boundary that already synchronizes) and resets it.  Never
+    # checkpointed: the loop strips it to ``()`` around save/restore, so
+    # checkpoint pytrees are identical with telemetry on or off (and
+    # pre-obs checkpoints restore unchanged).
+    telemetry: Any = ()
 
 
 def make_optimizer(
@@ -117,6 +127,7 @@ def make_train_step(
     grad_chunk: Optional[int] = None,
     faults=None,
     overlap: str = "off",
+    telemetry=None,
 ):
     """Build ``step(state, xb, yb[, rng]) -> (state, metrics)``.
 
@@ -161,6 +172,15 @@ def make_train_step(
     ``plan.spectral.stale_contraction_rho``).  The worker mean is untouched:
     every delta has zero column-mean.  Requires ``state.mix_pending`` to be
     a ``zeros([N, D])`` (``train/loop.py`` primes it).
+
+    ``telemetry``: optional ``obs.TelemetrySpec`` — when given *and* the
+    incoming ``state.telemetry`` is a real ``obs.Telemetry`` pytree, each
+    step folds its counters (disagreement, wire bytes at the configured
+    dtype, activated matchings, alive count, heal/stale/quantize events)
+    into it with a handful of fused scalar adds.  No host interaction
+    whatsoever happens here — the loop reads the accumulator once per
+    epoch (DESIGN.md §14).  ``None`` (or an empty ``state.telemetry``
+    slot) compiles the exact pre-observability program.
     """
     flags_arr = jnp.asarray(np.asarray(flags), jnp.float32)  # [T, M]
     n_workers = flattener.num_workers
@@ -215,12 +235,19 @@ def make_train_step(
             rng = jax.random.PRNGKey(0)
         rngs = jax.random.split(jax.random.fold_in(rng, state.step), n)
 
-        (loss, (new_stats, logits)), grads = all_grads(
-            state.params, state.batch_stats, xb, yb, rngs
-        )
+        # device_span scopes: phase names ride the op metadata into the
+        # profiler (utils.profiling) — XLA fuses across these boundaries,
+        # so named scopes, not wall-clock brackets, are how the comp/comm
+        # split stays attributable (DESIGN.md §14)
+        with device_span("matcha/fwd_bwd"):
+            (loss, (new_stats, logits)), grads = all_grads(
+                state.params, state.batch_stats, xb, yb, rngs
+            )
 
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        with device_span("matcha/sgd"):
+            updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+            params = optax.apply_updates(state.params, updates)
 
         # consensus transform on the flattened parameter stack
         flat = flattener.flatten(params)
@@ -238,22 +265,23 @@ def make_train_step(
                 mask_worker_rows,
             )
 
-            flat = inject_nan_rows(flat, inject_arr[t])
-            flat, alive, healed, row_finite = heal_and_mask(
-                flat, alive_arr[t], revive_arr[t])
-            keep = 1.0 - healed
-            opt_state = mask_worker_rows(opt_state, keep, n)
-            comm_carry = mask_worker_rows(comm_carry, keep, n)
-            if overlap_on:
-                # a healed worker restarts from the survivors' average: the
-                # delta issued from its pre-heal parameters is stale
-                # algorithm state like momentum, and is dropped with it
-                mix_pending = mask_worker_rows(mix_pending, keep, n)
-            # BN running stats can be neither kept (poisoned/stale) nor
-            # zero-reset (variance 0 is not neutral): the healed worker
-            # adopts the donors' statistics along with their parameters
-            new_stats = heal_worker_stat_rows(new_stats, healed,
-                                              alive * keep, n)
+            with device_span("matcha/heal"):
+                flat = inject_nan_rows(flat, inject_arr[t])
+                flat, alive, healed, row_finite = heal_and_mask(
+                    flat, alive_arr[t], revive_arr[t])
+                keep = 1.0 - healed
+                opt_state = mask_worker_rows(opt_state, keep, n)
+                comm_carry = mask_worker_rows(comm_carry, keep, n)
+                if overlap_on:
+                    # a healed worker restarts from the survivors' average:
+                    # the delta issued from its pre-heal parameters is stale
+                    # algorithm state like momentum, and is dropped with it
+                    mix_pending = mask_worker_rows(mix_pending, keep, n)
+                # BN running stats can be neither kept (poisoned/stale) nor
+                # zero-reset (variance 0 is not neutral): the healed worker
+                # adopts the donors' statistics along with their parameters
+                new_stats = heal_worker_stat_rows(new_stats, healed,
+                                                  alive * keep, n)
         if overlap_on:
             # pipelined: consume the exchange issued at step t−1 (a pure
             # add — zero delta at step 0), then issue this step's exchange;
@@ -268,11 +296,14 @@ def make_train_step(
                     communicator.begin_mix, flat, comm_carry, flags_arr[t],
                     alive, gate=row_finite)
         elif alive is None:
-            flat, carry = communicator.step(flat, comm_carry, flags_arr[t])
+            with device_span("comm/step"):
+                flat, carry = communicator.step(flat, comm_carry,
+                                                flags_arr[t])
         else:
-            flat, carry = gossip_quarantined(
-                communicator.step, flat, comm_carry, flags_arr[t], alive,
-                gate=row_finite)
+            with device_span("comm/step"):
+                flat, carry = gossip_quarantined(
+                    communicator.step, flat, comm_carry, flags_arr[t], alive,
+                    gate=row_finite)
         params = flattener.unflatten(flat)
 
         def _fleet_mean(v):
@@ -312,6 +343,22 @@ def make_train_step(
         if faults is not None:
             metrics["healed"] = jnp.sum(healed)
             metrics["alive_workers"] = jnp.sum(alive)
+        new_tel = state.telemetry
+        if telemetry is not None and not isinstance(state.telemetry, tuple):
+            # pure scalar adds fused into the step — the structure check is
+            # trace-time (the pytree shape is static), so a run without the
+            # telemetry slot compiles the exact pre-observability program
+            heal_count = metrics.get("healed")
+            new_tel = telemetry_step(
+                state.telemetry, telemetry,
+                disagreement=metrics["disagreement"],
+                flags_t=flags_arr[t],
+                alive_count=(metrics["alive_workers"] if faults is not None
+                             else jnp.asarray(np.float32(n))),
+                healed=heal_count,
+                # overlapped heal drops the healed rows' pending deltas
+                stale_dropped=(heal_count if overlap_on else None),
+            )
         return (
             state.replace(
                 params=params,
@@ -319,6 +366,7 @@ def make_train_step(
                 opt_state=opt_state,
                 comm_carry=carry,
                 mix_pending=mix_pending if overlap_on else state.mix_pending,
+                telemetry=new_tel,
                 step=state.step + 1,
             ),
             metrics,

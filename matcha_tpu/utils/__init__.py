@@ -2,7 +2,7 @@
 
 from .metrics import AverageMeter, cross_entropy_loss, top_k_accuracy
 from .platform import pin_platform, user_cache_dir
-from .profiling import annotate, trace
+from .profiling import annotate, device_span, trace
 
-__all__ = ["AverageMeter", "annotate", "cross_entropy_loss", "pin_platform", "user_cache_dir",
-           "top_k_accuracy", "trace"]
+__all__ = ["AverageMeter", "annotate", "cross_entropy_loss", "device_span",
+           "pin_platform", "user_cache_dir", "top_k_accuracy", "trace"]

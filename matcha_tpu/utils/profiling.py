@@ -18,7 +18,7 @@ from pathlib import Path
 
 import jax
 
-__all__ = ["trace", "annotate"]
+__all__ = ["trace", "annotate", "device_span"]
 
 
 @contextlib.contextmanager
@@ -47,5 +47,27 @@ def annotate(name: str):
 
     Wrap host-side phases (data staging, checkpointing, the comm-split
     timer) so they are attributable in the trace alongside device work.
+
+    **Host phases only.**  Inside a jitted function this bracket exists at
+    *trace* time, not run time — XLA fuses the gossip into the step, so a
+    wall-clock bracket around ``begin_mix`` would measure nothing (the
+    round-1 lesson behind the two-program comm split).  For in-graph
+    phases use :func:`device_span`, whose name lands in the op metadata of
+    everything traced under it and therefore survives into the executed
+    kernels' profiler rows — spans, not wall-clock brackets, are the
+    source of truth for the compute/comm split.
     """
     return jax.profiler.TraceAnnotation(name)
+
+
+def device_span(name: str):
+    """Named scope for *in-graph* phases (``jax.named_scope``).
+
+    Ops traced under the scope carry ``name`` in their HLO metadata, so a
+    ``jax.profiler`` trace attributes the fused step's kernels to the
+    phase that emitted them (``matcha/begin_mix``, ``matcha/apply_mix``,
+    ``matcha/heal``, ...) even after XLA fuses across the phase boundary.
+    Pure trace-time construct: adds zero runtime work and cannot trip the
+    retrace sanitizer (tests/test_obs.py pins both properties).
+    """
+    return jax.named_scope(name)

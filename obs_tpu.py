@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Observability CLI — render a run's unified journal (DESIGN.md §14).
+
+Every saved run writes ``events.jsonl`` next to its Recorder CSVs: the
+schema-versioned stream of telemetry flushes, fault-ledger events, drift
+trips, checkpoint writes, and retrace detections.  This tool turns one (or
+several) of those into something a human — or a session log — can read.
+
+Commands
+--------
+``summary RUN [--md PATH]``
+    One-screen report: config + plan header, per-epoch table (loss,
+    disagreement, wire bytes, matchings, alive floor, heal counts,
+    timings), fault/drift/retrace events, total bytes on wire.  ``--md``
+    additionally writes the same report as a markdown artifact.
+
+``tail RUN [-n N]``
+    The last N journal events, one per line — "what just happened".
+
+``drift RUN [--rho R] [--tolerance T] [--patience K] [--steps-per-epoch S]``
+    Replay the planner-drift analysis over the journal: measured per-epoch
+    disagreement contraction vs the predicted ρ band the run recorded at
+    start (every flag overrides — ``--rho`` asks "would this run have
+    satisfied *that* plan?").  Exit 1 when drift is detected (replayed or
+    live-journaled), 0 when the run is within band.
+
+``compare SRC... [--md PATH]``
+    One table across heterogeneous sources: run dirs / journals (their
+    ``bench`` events, or the final telemetry row) and bare
+    ``BENCH_r*.json`` / ``benchmarks/bench_live_r*.json`` records — so
+    pre-journal rounds and journal-emitting rounds land side by side.
+
+``RUN`` is a run directory (holding ``events.jsonl``) or a journal path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _load(source: str):
+    from matcha_tpu.obs import read_journal, resolve_journal_path
+
+    path = resolve_journal_path(source)
+    return read_journal(path), path
+
+
+def cmd_summary(args) -> int:
+    from matcha_tpu.obs.report import render_summary, render_summary_markdown
+
+    events, path = _load(args.run)
+    print(render_summary(events, source=path))
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(render_summary_markdown(events, source=path))
+        print(f"# markdown written to {args.md}", file=sys.stderr)
+    return 0
+
+
+def cmd_tail(args) -> int:
+    from matcha_tpu.obs.report import render_tail
+
+    events, _ = _load(args.run)
+    print(render_tail(events, n=args.n))
+    return 0
+
+
+def cmd_drift(args) -> int:
+    from matcha_tpu.obs import drift_report
+
+    events, path = _load(args.run)
+    report = drift_report(events, rho=args.rho, tolerance=args.tolerance,
+                          patience=args.patience,
+                          steps_per_epoch=args.steps_per_epoch)
+    print(f"journal: {path}")
+    print(f"predicted: rho={report['rho']:.6g} over "
+          f"{report['steps_per_epoch']} steps/epoch -> per-epoch factor "
+          f"{report['predicted_factor']:.4g} "
+          f"(band <= {report['band']:.4g}, patience {report['patience']})")
+    pairs = zip(report["epochs"][1:], report["measured_factors"])
+    factors = "  ".join(f"e{ep}:{f:.3g}" for ep, f in pairs)
+    print(f"measured factors: {factors}")
+    print(f"checked epochs: {report['checked_epochs']}, "
+          f"violations: {report['violations']}")
+    if report.get("rebases"):
+        print(f"plan re-based {report['rebases']}x mid-run (alpha "
+              f"re-derivation / config-changed resume); rho above is the "
+              f"final segment's")
+    for trip in report["trips"]:
+        print(f"DRIFT (replayed): epoch {trip['epoch']} measured "
+              f"{trip['measured_factor']:.4g} > band {report['band']:.4g}")
+    for e in report["journaled"]:
+        print(f"DRIFT (journaled live): epoch {e.get('epoch')} measured "
+              f"{e.get('measured_factor'):.4g}")
+    print("verdict: " + ("within the predicted tolerance band"
+                         if report["consistent"] else "PLANNER DRIFT"))
+    return 0 if report["consistent"] else 1
+
+
+def cmd_compare(args) -> int:
+    from matcha_tpu.obs.report import compare_sources, render_compare
+
+    rows, problems = compare_sources(args.sources)
+    if not rows:
+        print("nothing comparable found", file=sys.stderr)
+        for p in problems:
+            print(f"# {p}", file=sys.stderr)
+        return 2
+    print(render_compare(rows, problems))
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(render_compare(rows, problems, markdown=True) + "\n")
+        print(f"# markdown written to {args.md}", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summary", help="one-screen run report")
+    s.add_argument("run", help="run dir (with events.jsonl) or journal path")
+    s.add_argument("--md", default=None, help="also write a markdown report")
+    s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser("tail", help="last N journal events")
+    s.add_argument("run")
+    s.add_argument("-n", type=int, default=20)
+    s.set_defaults(fn=cmd_tail)
+
+    s = sub.add_parser("drift", help="measured contraction vs predicted rho")
+    s.add_argument("run")
+    s.add_argument("--rho", type=float, default=None,
+                   help="override the journal's predicted rho (what-if)")
+    s.add_argument("--tolerance", type=float, default=None)
+    s.add_argument("--patience", type=int, default=None)
+    s.add_argument("--steps-per-epoch", type=int, default=None,
+                   dest="steps_per_epoch")
+    s.set_defaults(fn=cmd_drift)
+
+    s = sub.add_parser("compare", help="table across runs / bench records")
+    s.add_argument("sources", nargs="+",
+                   help="run dirs, journal files, or BENCH_r*.json records")
+    s.add_argument("--md", default=None)
+    s.set_defaults(fn=cmd_compare)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"obs_tpu: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

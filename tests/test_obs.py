@@ -1,0 +1,610 @@
+"""Observability subsystem (ISSUE 7): telemetry, journal, drift, CLI.
+
+Layered like the subsystem: pure units (wire-byte accounting, schema
+validation, the drift monitor's band logic), the Recorder's append-only
+CSV + journal sink contracts, profiling helpers, and two end-to-end CPU
+ring-8 MATCHA runs shared module-wide — a *consistent* one (measured
+contraction within the predicted ρ band) and a deliberately *mis-planned*
+one (``alpha_override`` executes 5% of the solved α while the monitor
+predicts with the solved α) that must trip a ``drift`` journal event.
+"""
+
+import dataclasses
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from matcha_tpu.obs import (
+    DriftMonitor,
+    Telemetry,
+    append_journal_record,
+    compose_predicted_rho,
+    drift_report,
+    epoch_series,
+    make_event,
+    read_journal,
+    validate_event,
+)
+from matcha_tpu.obs.telemetry import make_telemetry_spec
+from matcha_tpu.parallel.gossip import matching_wire_bytes
+from matcha_tpu.train import TrainConfig, train
+from matcha_tpu.train.recorder import Recorder
+
+pytestmark = pytest.mark.obs
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# ring-8 MATCHA at budget 0.5, pure gossip (lr 0) from an *unsynced* init:
+# the consensus-dominant regime where per-epoch contraction is measurable
+# against rho — the same recipe as the committed reference journal
+BASE = TrainConfig(
+    name="obs", model="mlp", dataset="synthetic",
+    dataset_kwargs={"num_train": 256, "num_test": 32},
+    num_workers=8, graphid=5, batch_size=8, epochs=6, lr=0.0,
+    warmup=False, momentum=0.0, weight_decay=0.0, matcha=True, budget=0.5,
+    seed=3, save=True, sync_init=False, eval_every=0,
+    measure_comm_split=False,
+)
+
+
+@pytest.fixture(scope="module")
+def ring8_run(tmp_path_factory):
+    root = tmp_path_factory.mktemp("obs_ring8")
+    cfg = dataclasses.replace(BASE, name="ring8", savePath=str(root))
+    result = train(cfg)
+    return result, str(root / "ring8_mlp")
+
+
+@pytest.fixture(scope="module")
+def misplan_run(tmp_path_factory):
+    root = tmp_path_factory.mktemp("obs_misplan")
+    cfg = dataclasses.replace(BASE, name="misplan", savePath=str(root),
+                              alpha_override=0.03)
+    result = train(cfg)
+    return result, str(root / "misplan_mlp")
+
+
+# ---------------------------------------------------------------- telemetry
+
+def test_telemetry_accumulates_against_static_accounting(ring8_run):
+    """Per-epoch counters must equal the schedule's own static accounting:
+    steps = batches/epoch, matchings = the flag rows' sum, wire bytes = the
+    fired matchings' dense exchange at f32 — the device-side accumulator
+    is bookkeeping, not an estimate."""
+    result, _ = ring8_run
+    events = result.recorder.events
+    epochs, steps = epoch_series(events, "telemetry", "steps")
+    assert epochs == list(range(BASE.epochs))
+    assert all(s == 4.0 for s in steps)  # 256 train / 8 workers / bs 8
+    flags = np.asarray(result.schedule.flags, np.float64)
+    bytes_vec = matching_wire_bytes(result.schedule.decomposed,
+                                    _flat_dim(result), "f32")
+    _, wire = epoch_series(events, "telemetry", "wire_bytes")
+    _, match = epoch_series(events, "telemetry", "matchings_mean")
+    for e in range(BASE.epochs):
+        rows = flags[e * 4:(e + 1) * 4]
+        assert match[e] == pytest.approx(rows.sum() / 4.0)
+        # f32 accumulator vs f64 reference: exact to f32 resolution
+        assert wire[e] == pytest.approx(float(rows.sum(0) @ bytes_vec),
+                                        rel=1e-5)
+    _, alive = epoch_series(events, "telemetry", "alive_min")
+    assert all(a == 8.0 for a in alive)
+    _, quant = epoch_series(events, "telemetry", "quantized_values")
+    assert all(q == 0.0 for q in quant)  # f32 wire quantizes nothing
+
+
+def _flat_dim(result) -> int:
+    leaves = [np.asarray(v) for v in
+              __import__("jax").tree_util.tree_leaves(result.state.params)]
+    return sum(int(np.prod(l.shape[1:])) for l in leaves)
+
+
+def test_matching_wire_bytes_static_and_bf16_halves():
+    dec = [[(0, 1), (2, 3)], [(1, 2)]]
+    f32 = matching_wire_bytes(dec, dim=10, wire_dtype="f32")
+    bf16 = matching_wire_bytes(dec, dim=10, wire_dtype="bf16")
+    assert f32.tolist() == [2 * 2 * 10 * 4, 2 * 1 * 10 * 4]
+    assert (bf16 * 2 == f32).all()
+    spec32 = make_telemetry_spec(dec, 10, wire_dtype="f32")
+    spec16 = make_telemetry_spec(dec, 10, wire_dtype="bf16", overlap="1step")
+    assert not spec32.quantizing and not spec32.overlap
+    assert spec16.quantizing and spec16.overlap
+    assert (spec16.wire_values_per_matching
+            == spec32.wire_values_per_matching).all()
+
+
+def test_telemetry_never_trips_retrace_watch(ring8_run):
+    """The accumulator is part of the scanned carry: if it caused
+    per-epoch recompiles the journal would record a retrace event.
+
+    Regression pin: the watch's first-ever run caught a real one —
+    ``shard_workers`` placed state with ``P('workers', None, ...)`` while
+    the compiled epoch returned ``P('workers')``; the specs describe the
+    same placement but miss the jit cache, so every mesh run silently
+    recompiled the whole epoch program at epoch 1 (fixed in
+    ``parallel/mesh.py``).  Under the 8-device conftest mesh this test
+    re-trips on any such cache-key drift."""
+    result, _ = ring8_run
+    assert not [e for e in result.recorder.events
+                if e["kind"] == "retrace"]
+
+
+def test_overlap_bf16_counters_journal(tmp_path):
+    """The pipelined + narrow-wire run journals what it does: every step
+    consumes a one-step-stale mix, and every fired matching's exchanged
+    values count as quantized (bf16 wire) with bytes exactly half of the
+    f32 ledger for the same flags."""
+    cfg = dataclasses.replace(
+        BASE, name="ov", savePath=str(tmp_path), epochs=2,
+        overlap="1step", wire_dtype="bf16",
+        dataset_kwargs={"num_train": 64, "num_test": 32})
+    result = train(cfg)
+    events = result.recorder.events
+    _, steps = epoch_series(events, "telemetry", "steps")
+    _, stale = epoch_series(events, "telemetry", "stale_steps")
+    assert stale == steps  # every pipelined step consumes a stale mix
+    _, quant = epoch_series(events, "telemetry", "quantized_values")
+    _, wire = epoch_series(events, "telemetry", "wire_bytes")
+    flags = np.asarray(result.schedule.flags, np.float64)
+    bytes_bf16 = matching_wire_bytes(result.schedule.decomposed,
+                                     _flat_dim(result), "bf16")
+    bpe = int(steps[0])
+    for e in range(cfg.epochs):
+        rows = flags[e * bpe:(e + 1) * bpe]
+        assert wire[e] == pytest.approx(float(rows.sum(0) @ bytes_bf16),
+                                        rel=1e-5, abs=1e-6)
+        # value count x 2 bytes == byte count (bf16 ledger is half of f32);
+        # an epoch whose flags never fired legitimately counts zero
+        assert quant[e] * 2 == pytest.approx(wire[e], rel=1e-5, abs=1e-6)
+
+
+# ------------------------------------------------------------------ journal
+
+def test_reference_journal_validates_line_by_line():
+    """The committed artifact pins the schema: every line must validate,
+    and the kinds the docs promise must actually occur."""
+    events = read_journal(str(REPO / "benchmarks" / "events_ring8.jsonl"))
+    assert events, "reference journal is empty"
+    for i, e in enumerate(events):
+        assert validate_event(e) == [], f"line {i + 1}: {validate_event(e)}"
+    kinds = {e["kind"] for e in events}
+    assert {"run_start", "epoch", "telemetry"} <= kinds
+    start = events[0]
+    assert start["kind"] == "run_start"
+    assert 0.0 < start["predicted"]["rho"] < 1.0
+    assert start["predicted"]["steps_per_epoch"] == 4
+    # the journal's telemetry series is strictly ordered and parseable
+    epochs, d = epoch_series(events, "telemetry", "disagreement_mean")
+    assert epochs == sorted(epochs) and len(epochs) >= 6
+    assert all(v > 0 for v in d)
+
+
+def test_validate_event_rejects_drift():
+    ok = make_event("telemetry", 1.0, epoch=0, steps=4.0,
+                    disagreement_mean=0.1, disagreement_last=0.1,
+                    wire_bytes=1.0, matchings_mean=1.0, alive_mean=8.0)
+    assert validate_event(ok) == []
+    assert validate_event({"v": 2, "kind": "telemetry", "t": 0.0})
+    assert any("unknown kind" in p
+               for p in validate_event(make_event("nonsense", 0.0)))
+    assert any("missing" in p
+               for p in validate_event(make_event("drift", 0.0)))
+    assert any("t=" in p for p in
+               validate_event({"v": 1, "kind": "resume", "t": -1.0}))
+
+
+def test_run_journal_is_written_and_faults_view_absent(ring8_run):
+    """A fault-free saved run writes events.jsonl but no faults.json —
+    the ledger is a view that only materializes when fault events exist."""
+    _, run_dir = ring8_run
+    assert os.path.exists(os.path.join(run_dir, "events.jsonl"))
+    assert not os.path.exists(os.path.join(run_dir, "faults.json"))
+    disk = read_journal(os.path.join(run_dir, "events.jsonl"))
+    assert [e["kind"] for e in disk][0] == "run_start"
+
+
+def test_plan_verify_reads_ledger_from_journal(tmp_path):
+    """`plan verify` back-compat: a run dir holding only events.jsonl (no
+    faults.json view) still yields the degradation summary."""
+    from matcha_tpu.plan.verify import load_fault_ledger
+
+    run = tmp_path / "run"
+    run.mkdir()
+    ev = make_event("plan", 0.1, name="chaos", events=[],
+                    expected_alive=[1.0, 0.5], expected_link_up=[0.9])
+    (run / "events.jsonl").write_text(json.dumps(ev) + "\n")
+    ledger = load_fault_ledger(str(run))
+    assert ledger is not None
+    assert ledger["expected_alive"] == [1.0, 0.5]
+    assert load_fault_ledger(str(tmp_path / "nowhere")) is None
+
+
+# ----------------------------------------------------------------- recorder
+
+def _mini_config(tmp_path, name="rec"):
+    return dataclasses.replace(BASE, name=name, savePath=str(tmp_path),
+                               epochs=25)
+
+
+def _feed(recorder, rng, epochs):
+    for _ in range(epochs):
+        recorder.add_epoch(
+            epoch_time=float(rng.uniform(1, 2)),
+            comp_time=float(rng.uniform(0.5, 1)),
+            comm_time=float(rng.uniform(0, 0.5)),
+            train_acc=rng.uniform(size=recorder.num_workers),
+            train_loss=rng.uniform(size=recorder.num_workers),
+            test_acc=rng.uniform(size=recorder.num_workers),
+            disagreement=float(rng.uniform()),
+        )
+
+
+def test_recorder_append_only_flush_is_byte_identical(tmp_path, monkeypatch):
+    """ISSUE 7 satellite: incremental flushes (the O(1)-per-flush append
+    path) must produce byte-for-byte the CSVs a single full rewrite
+    would.  Identical data through both recorders; one saves at the
+    10-epoch cadence + final, the other exactly once.  The wall clock is
+    faked deterministic — ``recordtime`` is a real series and must byte-
+    compare too."""
+    import matcha_tpu.train.recorder as recorder_mod
+
+    fake = {"now": 1000.0}
+
+    def fake_time():
+        fake["now"] += 0.125
+        return fake["now"]
+
+    monkeypatch.setattr(recorder_mod.time, "time", fake_time)
+    cfg_a = _mini_config(tmp_path / "a")
+    cfg_b = _mini_config(tmp_path / "b")
+    # run A fully, then rewind the fake clock and run B: save() never reads
+    # the clock, so both recorders see the identical timestamp stream and
+    # even the recordtime series must byte-compare
+    rec_a = Recorder(cfg_a, 4)
+    rng_a = np.random.default_rng(7)
+    for flush_at in (10, 10, 5):  # 25 epochs in three uneven flushes
+        _feed(rec_a, rng_a, flush_at)
+        rec_a.save()
+    fake["now"] = 1000.0
+    rec_b = Recorder(cfg_b, 4)
+    _feed(rec_b, np.random.default_rng(7), 25)
+    rec_b.save()
+    logs_a = sorted(p.name for p in pathlib.Path(rec_a.folder).glob("*.log"))
+    logs_b = sorted(p.name for p in pathlib.Path(rec_b.folder).glob("*.log"))
+    assert logs_a == logs_b and len(logs_a) == 4 * 8  # 4 ranks x 8 series
+    for name in logs_a:
+        a = (pathlib.Path(rec_a.folder) / name).read_bytes()
+        b = (pathlib.Path(rec_b.folder) / name).read_bytes()
+        assert a == b, f"append-only flush diverged from full write: {name}"
+        assert len(a.splitlines()) == 25
+
+
+def test_recorder_append_only_rewrites_after_resume(tmp_path):
+    """After load_previous the disk file may hold MORE rows than memory
+    (resume from an older checkpoint): the next save must truncate-rewrite,
+    not append — and the journal must extend, never rewrite."""
+    cfg = _mini_config(tmp_path)
+    rec = Recorder(cfg, 4)
+    _feed(rec, np.random.default_rng(0), 10)
+    rec.save()
+    events_before = len(read_journal(rec.journal.path))
+    rec2 = Recorder(cfg, 4)
+    assert rec2.load_previous(6) == 6  # resume at epoch 6: truncates to 6
+    _feed(rec2, np.random.default_rng(1), 2)
+    rec2.save()
+    a_log = next(pathlib.Path(rec2.folder).glob("*-r0-losses.log"))
+    assert len(a_log.read_bytes().splitlines()) == 8  # 6 kept + 2 new
+    events_after = read_journal(rec2.journal.path)
+    assert len(events_after) == events_before + 2  # extended, not rewritten
+    assert [e["kind"] for e in events_after[:events_before]] \
+        == [e["kind"] for e in read_journal(rec.journal.path)][:events_before]
+
+
+def test_journal_repairs_crash_truncated_tail(tmp_path):
+    """A crash mid-append leaves a partial final line: strict reads stay
+    loud, the resume path repairs (drops the tail) and schedules a full
+    rewrite so the next flush leaves a whole file — never a broken line
+    buried mid-stream."""
+    cfg = _mini_config(tmp_path)
+    rec = Recorder(cfg, 4)
+    _feed(rec, np.random.default_rng(0), 3)
+    rec.save()
+    whole = len(read_journal(rec.journal.path))
+    with open(rec.journal.path, "a") as f:
+        f.write('{"v": 1, "kind": "epo')  # the crash-truncated tail
+    with pytest.raises(ValueError, match="malformed journal line"):
+        read_journal(rec.journal.path)
+    rec2 = Recorder(cfg, 4)
+    rec2.load_previous(3)
+    assert len(rec2.events) == whole  # parsed prefix, tail dropped
+    _feed(rec2, np.random.default_rng(1), 1)
+    rec2.save()
+    healed = read_journal(rec2.journal.path)  # strict read: whole again
+    assert len(healed) == whole + 1  # prefix + the one post-resume epoch
+    # a malformed line mid-file is corruption, not a crash tail: loud even
+    # with repair on
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"v": 1, "kind": "resume", "t": 0.0}\nnot json\n'
+                   '{"v": 1, "kind": "resume", "t": 1.0}\n')
+    with pytest.raises(ValueError):
+        read_journal(str(bad), repair=True)
+
+
+def test_recorder_faults_view_round_trips(tmp_path):
+    cfg = _mini_config(tmp_path)
+    rec = Recorder(cfg, 4)
+    rec.log_fault("rollback", epoch=3, reason="test", lr_scale=0.5,
+                  attempt=1)
+    _feed(rec, np.random.default_rng(0), 1)
+    rec.save()
+    with open(os.path.join(rec.folder, "faults.json")) as f:
+        ledger = json.load(f)
+    [entry] = ledger["events"]
+    assert entry["kind"] == "rollback" and entry["epoch"] == 3
+    assert "recordtime" in entry and "v" not in entry  # historical shape
+    # and the same event is in the journal with the envelope
+    journal = read_journal(rec.journal.path)
+    assert [e for e in journal if e["kind"] == "rollback"]
+
+
+# ---------------------------------------------------------------- profiling
+
+def test_trace_creates_nonempty_trace_dir(tmp_path):
+    """ISSUE 7 satellite: `trace` must create the log dir and produce a
+    non-empty capture on CPU (the TensorBoard/Perfetto artifact path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu.utils import trace
+
+    log_dir = tmp_path / "tb" / "nested"
+    f = jax.jit(lambda x: jnp.sum(x * x))
+    f(jnp.ones(16))  # compile outside the trace window
+    with trace(str(log_dir)):
+        out = f(jnp.ones(16))
+        jax.block_until_ready(out)
+    produced = [p for p in log_dir.rglob("*") if p.is_file()]
+    assert produced, "profiler trace produced no files"
+    assert any(p.stat().st_size > 0 for p in produced)
+
+
+def test_annotate_and_device_span_nest_in_jit_without_retrace():
+    """ISSUE 7 satellite: both span helpers must be trace-pure — a step
+    using them compiles once and never again (the retrace sanitizer is
+    the arbiter, same as for the production step)."""
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu.analysis.sanitizer import check_single_trace, retrace_guard
+    from matcha_tpu.utils import annotate, device_span
+
+    def step(x):
+        with device_span("test/phase_a"):
+            y = x * 2.0
+        with device_span("test/phase_b"):
+            with device_span("test/nested"):
+                return jnp.sum(y)
+
+    guarded, counter = retrace_guard(jax.jit(step))
+    with annotate("test/host_phase"):
+        for _ in range(4):
+            guarded(jnp.ones(8)).block_until_ready()
+    check_single_trace(counter, "span step")
+    assert counter.count == 1
+
+
+# -------------------------------------------------------------------- drift
+
+def test_drift_monitor_band_logic_units():
+    fast = DriftMonitor(0.6, 2, tolerance=0.25, patience=2)
+    d = 1.0
+    assert all(fast.observe(e, d * (0.55 ** e)) is None for e in range(8))
+    flat = DriftMonitor(0.6, 2, tolerance=0.25, patience=2)
+    trips = [flat.observe(e, 1.0 * (0.97 ** e)) for e in range(8)]
+    assert any(t is not None for t in trips)
+    first = next(t for t in trips if t is not None)
+    assert first["measured_factor"] > first["predicted_factor"] * 1.25
+    with pytest.raises(ValueError):
+        DriftMonitor(0.5, 0)
+    with pytest.raises(ValueError):
+        DriftMonitor(0.5, 2, patience=0)
+
+
+def test_drift_report_rebases_on_alpha_rederivation():
+    """Replay parity with the live monitor: a mid-run α re-derivation
+    re-based the live prediction, so the replay must re-base at the same
+    epoch — the same decaying series that trips against the original
+    (optimistic) ρ is in-band once the journaled re-derivation applies.
+    An explicit --rho what-if still overrides everything."""
+    def journal(with_rederivation):
+        events = [make_event("run_start", 0.0, config={},
+                             predicted={"rho": 0.09, "steps_per_epoch": 2,
+                                        "tolerance": 0.25, "patience": 2})]
+        d = 1.0
+        for ep in range(6):
+            if with_rederivation and ep == 1:
+                events.append(make_event(
+                    "alpha_rederived", float(ep), epoch=ep, old=0.6,
+                    new=0.2, rho=0.8, predicted={"rho": 0.8}))
+            events.append(make_event(
+                "telemetry", float(ep), epoch=ep, steps=2.0,
+                disagreement_mean=d, disagreement_last=d, wire_bytes=1.0,
+                matchings_mean=1.0, alive_mean=8.0))
+            d *= 0.8
+        return events
+
+    tripped = drift_report(journal(with_rederivation=False))
+    assert not tripped["consistent"]  # 0.8/epoch vs rho 0.09: drift
+    rebased = drift_report(journal(with_rederivation=True))
+    assert rebased["consistent"]      # re-derived plan promises 0.8: in band
+    what_if = drift_report(journal(with_rederivation=True), rho=0.09,
+                           patience=1)
+    assert not what_if["consistent"]  # explicit --rho wins over re-basing
+    assert rebased["rebases"] == 1 and tripped["rebases"] == 0
+    # counters accumulate across plan segments instead of resetting
+    assert rebased["checked_epochs"] >= tripped["checked_epochs"] - 1
+
+
+def test_drift_what_if_ignores_live_journaled_events(misplan_run):
+    """`--rho` asks "would this run have satisfied THAT plan?" — the live
+    drift events were scored against the ORIGINAL plan and must not veto
+    the what-if answer.  The mis-planned run, scored against the rho its
+    overridden alpha actually delivers (≈1 ⇒ predicted factor 1), is
+    consistent; without the override the journaled events still damn it."""
+    import obs_tpu
+
+    _, run_dir = misplan_run
+    assert obs_tpu.main(["drift", run_dir]) == 1
+    assert obs_tpu.main(["drift", run_dir, "--rho", "0.9999"]) == 0
+
+
+def test_compose_predicted_rho_consistency():
+    from matcha_tpu.schedule.solvers import contraction_rho
+    from matcha_tpu.topology import matching_laplacians, select_graph
+
+    dec = select_graph(5)  # 8-node ring
+    Ls = matching_laplacians(dec, 8)
+    probs = np.full(len(dec), 0.7)
+    base = compose_predicted_rho(Ls, probs, 0.5)
+    assert base["rho"] == pytest.approx(
+        float(contraction_rho(Ls, probs, 0.5)))
+    assert base["wire_eps"] == 0.0
+    bf16 = compose_predicted_rho(Ls, probs, 0.5, wire_dtype="bf16")
+    assert bf16["rho"] > base["rho"]  # quantization can only slow the bound
+    assert bf16["floor_rel"] == pytest.approx(2.0 * 2.0 ** -8)
+    degraded = compose_predicted_rho(Ls, probs, 0.5,
+                                     worker_alive=np.full(8, 0.8))
+    assert degraded["rho"] >= base["rho"]  # deaths only slow contraction
+    assert degraded["rho_base"] == base["rho_base"]
+
+
+def test_ring8_run_is_within_predicted_band(ring8_run):
+    """Acceptance: the CPU ring-8 MATCHA run's measured per-epoch
+    contraction stays inside the predicted ρ tolerance band — no drift
+    journaled live, none found on replay."""
+    result, run_dir = ring8_run
+    assert not [e for e in result.recorder.events if e["kind"] == "drift"]
+    report = drift_report(read_journal(os.path.join(run_dir,
+                                                    "events.jsonl")))
+    assert report["consistent"]
+    assert report["violations"] == 0
+    assert report["predicted_factor"] == pytest.approx(
+        report["rho"] ** (report["steps_per_epoch"] / 2.0))
+
+
+def test_misplanned_alpha_trips_drift(misplan_run):
+    """Acceptance: executing 5% of the solved α while the monitor predicts
+    with the solved α must journal a drift event (live) and replay as
+    PLANNER DRIFT — and the run_start records both alphas so the journal
+    is self-explaining."""
+    result, run_dir = misplan_run
+    drift = [e for e in result.recorder.events if e["kind"] == "drift"]
+    assert drift, "mis-planned run journaled no drift event"
+    assert drift[0]["measured_factor"] > drift[0]["predicted_factor"]
+    events = read_journal(os.path.join(run_dir, "events.jsonl"))
+    start = events[0]
+    assert start["predicted"]["executed_alpha"] == pytest.approx(0.03)
+    assert start["predicted"]["plan_alpha"] > 0.1
+    report = drift_report(events)
+    assert not report["consistent"]
+    assert report["journaled"]
+
+
+# ---------------------------------------------------------------------- CLI
+
+def test_cli_summary_tail_and_markdown(ring8_run, tmp_path, capsys):
+    import obs_tpu
+
+    _, run_dir = ring8_run
+    md = tmp_path / "summary.md"
+    assert obs_tpu.main(["summary", run_dir, "--md", str(md)]) == 0
+    out = capsys.readouterr().out
+    assert "total wire bytes" in out and "rho=" in out
+    text = md.read_text()
+    assert text.startswith("# Run journal") and "| epoch |" in text
+    assert obs_tpu.main(["tail", run_dir, "-n", "5"]) == 0
+    assert "telemetry" in capsys.readouterr().out
+
+
+def test_cli_drift_exit_codes(ring8_run, misplan_run, capsys):
+    import obs_tpu
+
+    _, good = ring8_run
+    _, bad = misplan_run
+    assert obs_tpu.main(["drift", good]) == 0
+    assert "within the predicted tolerance band" in capsys.readouterr().out
+    assert obs_tpu.main(["drift", bad]) == 1
+    assert "PLANNER DRIFT" in capsys.readouterr().out
+    # what-if override: the good run scored against an absurdly optimistic
+    # plan (rho -> 0.01) must fail the band (patience 1: the floor guard
+    # leaves few checked epochs in a fast-converging run)
+    assert obs_tpu.main(["drift", good, "--rho", "0.01",
+                         "--patience", "1"]) == 1
+    capsys.readouterr()
+    assert obs_tpu.main(["drift", str(REPO / "benchmarks"
+                                      / "events_ring8.jsonl")]) == 0
+
+
+def test_cli_compare_mixes_bench_records_and_journals(ring8_run, tmp_path,
+                                                      capsys):
+    import obs_tpu
+
+    _, run_dir = ring8_run
+    journal = tmp_path / "bench_journal.jsonl"
+    record = {"metric": "gossip-steps/sec", "value": 123.4,
+              "unit": "gossip_steps_per_sec", "vs_baseline": 0.02,
+              "backend": "dense"}
+    append_journal_record(str(journal), "bench", record=record,
+                          status="measured")
+    rc = obs_tpu.main(["compare", str(journal),
+                       str(REPO / "BENCH_r01.json"), run_dir])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "123.4" in out and "BENCH_r01.json" in out
+    assert obs_tpu.main(["compare", str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_bench_journal_sink_appends_valid_event(tmp_path):
+    """bench.py --journal mirrors the final record as a `bench` event the
+    compare renderer reads (no subprocess: the sink function is the
+    contract; the orchestration around it is covered by
+    test_bench_contract)."""
+    import argparse
+
+    import bench
+
+    path = tmp_path / "j.jsonl"
+    args = argparse.Namespace(journal=str(path))
+    bench._journal_record(args, {"value": 5000.1, "unit": "x"}, "measured")
+    bench._journal_record(argparse.Namespace(journal=None), {"value": 1},
+                          "measured")  # no-op, must not create anything
+    [event] = read_journal(str(path))
+    assert validate_event(event) == []
+    assert event["record"]["value"] == 5000.1
+    assert event["status"] == "measured"
+
+
+# ------------------------------------------------------------- checkpointing
+
+def test_checkpoint_resume_with_telemetry(tmp_path):
+    """Telemetry is stripped from checkpoints and re-attached on resume:
+    a checkpointed+resumed run keeps journaling telemetry for the resumed
+    epochs and appends a `resume` event after the original journal."""
+    root = tmp_path / "ckpt"
+    cfg = dataclasses.replace(
+        BASE, name="resume", savePath=str(root), epochs=2,
+        checkpoint_every=2,
+        dataset_kwargs={"num_train": 64, "num_test": 32})
+    train(cfg)
+    ckpt = str(root / "resume_ckpt")
+    cfg2 = dataclasses.replace(cfg, epochs=4, resume=ckpt)
+    result = train(cfg2)
+    events = result.recorder.events
+    kinds = [e["kind"] for e in events]
+    assert "resume" in kinds and "checkpoint" in kinds
+    epochs, steps = epoch_series(events, "telemetry", "steps")
+    assert epochs == [0, 1, 2, 3]  # pre-crash + resumed epochs all present
+    assert all(s > 0 for s in steps)

@@ -146,6 +146,33 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--eval-batch", type=int, default=0,
                    help="test-set slice per compiled eval call per worker; "
                         "0 auto-sizes to keep workers x batch within HBM")
+    p.add_argument("--no-telemetry", action="store_true",
+                   help="disable the in-graph step counters and the live "
+                        "planner-drift monitor (DESIGN.md §14); the "
+                        "events.jsonl run journal itself rides --save and "
+                        "keeps recording epoch/fault/checkpoint events. "
+                        "Telemetry is a handful of fused scalar adds read "
+                        "once per epoch, so this exists for A/B "
+                        "measurement, not for speed")
+    p.add_argument("--drift-tolerance", type=float, default=0.25,
+                   dest="drift_tolerance",
+                   help="relative band over the predicted per-epoch "
+                        "contraction factor before an epoch counts as "
+                        "out-of-plan")
+    p.add_argument("--drift-patience", type=int, default=2,
+                   dest="drift_patience",
+                   help="consecutive out-of-band epochs before a drift "
+                        "event is journaled")
+    p.add_argument("--no-sync-init", action="store_true",
+                   help="skip the initial AllReduce sync of the per-worker "
+                        "inits: starts the fleet at a visible disagreement "
+                        "spread (consensus-dominant diagnostics runs)")
+    p.add_argument("--alpha-override", type=float, default=None,
+                   dest="alpha_override",
+                   help="execute the schedule with this mixing weight while "
+                        "the drift monitor keeps predicting with the solved "
+                        "alpha — the deliberate mis-plan knob for chaos-"
+                        "testing drift detection (obs_tpu.py drift)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                    help="pin the JAX backend before first use (the container "
                         "sitecustomize overrides JAX_PLATFORMS env vars; a "
@@ -180,6 +207,11 @@ def parse_args(argv=None) -> TrainConfig:
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         fault_plan=args.fault_plan, max_recoveries=args.max_recoveries,
         recovery_lr_backoff=args.recovery_lr_backoff,
+        telemetry=not args.no_telemetry,
+        drift_tolerance=args.drift_tolerance,
+        drift_patience=args.drift_patience,
+        sync_init=not args.no_sync_init,
+        alpha_override=args.alpha_override,
         eval_every=args.eval_every,
         eval_batch=args.eval_batch,
         fixed_mode=args.fixed_mode,
