@@ -68,25 +68,15 @@ import numpy as np
 
 NORTH_STAR = 5000.0
 
-# bf16 peak matmul TFLOP/s and HBM GB/s per chip, by device_kind substring.
-# Public figures (cloud.google.com/tpu/docs/system-architecture-tpu-vm).
-_CHIP_PEAKS = {
-    "v6": (918.0, 1640.0),
-    "v5p": (459.0, 2765.0),
-    "v5e": (197.0, 819.0),
-    "v5lite": (197.0, 819.0),
-    "v4": (275.0, 1228.0),
-    "v3": (123.0, 900.0),
-    "v2": (45.0, 700.0),
-}
-
 
 def _chip_peaks(device_kind: str):
-    kind = device_kind.lower().replace(" ", "")
-    for key, peaks in _CHIP_PEAKS.items():
-        if key in kind:
-            return peaks
-    return None, None
+    """bf16 peak matmul TFLOP/s and HBM GB/s per chip — the pinned table
+    now lives in matcha_tpu.obs.costs (ISSUE 8: ONE chip table in the
+    repo, shared with the automatic roofline); unknown kinds still return
+    (None, None) so CPU-provisional records carry no MFU."""
+    from matcha_tpu.obs.costs import chip_peaks
+
+    return chip_peaks(device_kind)
 
 
 def build(args):

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Regenerate the committed reference journal ``benchmarks/events_ring8.jsonl``.
+
+The journal is the schema pin: tier-1 validates it line by line
+(``tests/test_obs.py``), so the format cannot drift silently.  It is the
+exact ``events.jsonl`` of one CPU run — ring-8 MATCHA at budget 0.5, pure
+gossip (lr 0) from an unsynced init, telemetry on — the same recipe the
+obs test fixtures use.  Event *timings* (``t``, ``compile_seconds``) are
+wall-clock and differ across regenerations by design; the schema, kind
+sequence, and physics-derived payloads are deterministic (fixed seed).
+
+Regenerate after a journal schema bump (the v1→v2 bump of ISSUE 8 added
+``compile`` events from the cost ledger):
+
+    JAX_PLATFORMS=cpu python benchmarks/make_reference_journal.py
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    from matcha_tpu.train import TrainConfig, train
+
+    root = tempfile.mkdtemp(prefix="ref_journal_")
+    cfg = TrainConfig(
+        name="ring8", model="mlp", dataset="synthetic",
+        description="reference journal: ring-8 MATCHA budget 0.5, "
+                    "pure-gossip contraction (lr 0, unsynced init)",
+        dataset_kwargs={"num_train": 256, "num_test": 32},
+        num_workers=8, graphid=5, batch_size=8, epochs=8, lr=0.0,
+        warmup=False, momentum=0.0, weight_decay=0.0, matcha=True,
+        budget=0.5, seed=3, save=True, sync_init=False, eval_every=0,
+        measure_comm_split=False,
+    )
+    # savePath stays the default relative "runs" so the journaled config
+    # snapshot carries no machine-specific temp path — run from a tmp cwd
+    os.chdir(root)
+    train(cfg)
+    src = os.path.join(root, "runs", "ring8_mlp", "events.jsonl")
+    dst = os.path.join(REPO, "benchmarks", "events_ring8.jsonl")
+    shutil.copyfile(src, dst)
+    print(f"reference journal regenerated: {dst}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

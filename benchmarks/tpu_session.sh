@@ -76,6 +76,32 @@ done; done
 # (r4) is a mid-RPC stall that hangs the client forever — an unwrapped step
 # would wedge the whole session on the first stall and lose the later steps.
 
+# 1.6 roofline_r6 + profile_r6 (ISSUE 8: the performance-observability
+#     artifacts ROADMAP's "queued live artifacts" item asks for).
+#     roofline: compiled-cost ceilings at the north-star shape against the
+#     real chip's pinned peaks, with the measured rate from this round's
+#     bench journal — the measured/ceiling ratio is the Pallas-promotion
+#     gate number.  profile: trace one overlapped and one non-overlapped
+#     short train window and parse the executed kernels for the comm/comp
+#     overlap fraction — the first hardware answer to whether --overlap
+#     1step actually hides the exchange (obs_tpu.py profile exits 2 on a
+#     device-row-less trace, so a tunnel that fell back to CPU records an
+#     explicit failure, never a fake 0%).
+timeout -k 10 300 python obs_tpu.py roofline --source "$OBS_JOURNAL" \
+    --md benchmarks/roofline_r6.md \
+    || echo "roofline_r6: no finite ceilings (see stderr)"
+rm -rf benchmarks/trace_r6_off benchmarks/trace_r6_1step
+for ov in off 1step; do
+    timeout -k 30 420 python train_tpu.py --name "profgrid-$ov" \
+        --model mlp --dataset synthetic --graphid 2 --numworkers 16 \
+        --epoch 3 --backend auto --overlap "$ov" --no-comm-split \
+        --trace-dir "benchmarks/trace_r6_$ov" > /dev/null
+done
+timeout -k 10 120 python obs_tpu.py profile \
+    benchmarks/trace_r6_off benchmarks/trace_r6_1step \
+    --md benchmarks/profile_r6.md --journal "$OBS_JOURNAL" \
+    || echo "profile_r6: trace carried no device rows (CPU fallback?)"
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist

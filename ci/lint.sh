@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # ci/lint.sh — the static-analysis gate (ISSUE 6).
 #
-# Five stages, each loud on failure; the gate fails if any stage fails:
+# Six stages, each loud on failure; the gate fails if any stage fails:
 #
 #   1. graftlint     GL001–GL006 (syntactic) + GL101–GL104 (SPMD dataflow)
 #                    over the shipped surface (incl. matcha_tpu/obs and
@@ -10,9 +10,13 @@
 #                    schedule/plan artifact under benchmarks/
 #   3. analysis lane the same engines + the dynamic retrace sanitizer +
 #                    per-rule fixtures, as pytest (marker: analysis)
-#   4. obs lane      telemetry / journal / drift tests (marker: obs)
+#   4. obs lane      telemetry / journal / drift / cost-ledger /
+#                    overlap-truth tests (marker: obs)
 #   5. obs smoke     obs_tpu.py summary over the committed reference
 #                    journal — the renderer must parse what the repo ships
+#   6. roofline smoke  obs_tpu.py roofline on a tiny MLP ring-4 CPU config
+#                    — compiled-cost extraction must produce finite
+#                    ceilings (exit 1 otherwise) and a markdown artifact
 #
 # Fast pre-commit variant: lint only what changed vs a ref —
 #
@@ -48,5 +52,14 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
 
 echo "== obs_tpu summary smoke (reference journal) =="
 python obs_tpu.py summary benchmarks/events_ring8.jsonl >/dev/null || rc=1
+
+echo "== roofline smoke (tiny MLP ring-4, CPU provisional) =="
+ROOFLINE_MD="$(mktemp)"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python obs_tpu.py roofline \
+    --workers 4 --topology ring --model mlp --dataset synthetic \
+    --md "$ROOFLINE_MD" >/dev/null || rc=1
+# the artifact must be a real markdown report, not an empty touch
+grep -q '^# Automatic roofline' "$ROOFLINE_MD" || rc=1
+rm -f "$ROOFLINE_MD"
 
 exit $rc

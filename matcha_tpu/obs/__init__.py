@@ -16,9 +16,26 @@ Three layers (DESIGN.md §14), one import surface:
   bf16-floor / fault-degraded composition from ``plan.spectral``),
   journaling a ``drift`` event after K consecutive out-of-band epochs.
 
-``obs_tpu.py`` renders a run's journal (summary / tail / drift / compare).
+Plus the *performance* twin (DESIGN.md §15, ISSUE 8):
+
+* :mod:`costs` — compiled-cost introspection (``cost_analysis`` /
+  ``memory_analysis`` of every program the loop builds, journaled as v2
+  ``compile`` events) and the automatic roofline / §9 capacity tables.
+* :mod:`xprof` — executed-trace parsing: device-lane phase attribution
+  via the ``comm/*`` / ``matcha/*`` named scopes and the comm/comp
+  overlap fraction (loud when a trace has no device rows).
+
+``obs_tpu.py`` renders a run's journal (summary / tail / drift / compare)
+and the performance artifacts (roofline / capacity / profile).
 """
 
+from .costs import (
+    CostLedger,
+    analyze_program,
+    capacity_report,
+    chip_peaks,
+    roofline_report,
+)
 from .drift import DriftMonitor, compose_predicted_rho, drift_report
 from .journal import (
     EVENT_KINDS,
@@ -29,12 +46,15 @@ from .journal import (
     epoch_series,
     make_event,
     read_journal,
+    read_journal_tail,
     resolve_journal_path,
     validate_event,
 )
 from .telemetry import Telemetry, TelemetrySpec, telemetry_flush, telemetry_step
+from .xprof import TraceParseError, overlap_report, profile_report
 
 __all__ = [
+    "CostLedger",
     "DriftMonitor",
     "EVENT_KINDS",
     "FAULT_KINDS",
@@ -42,13 +62,21 @@ __all__ = [
     "SCHEMA_VERSION",
     "Telemetry",
     "TelemetrySpec",
+    "TraceParseError",
+    "analyze_program",
     "append_journal_record",
+    "capacity_report",
+    "chip_peaks",
     "compose_predicted_rho",
     "drift_report",
     "epoch_series",
     "make_event",
+    "overlap_report",
+    "profile_report",
     "read_journal",
+    "read_journal_tail",
     "resolve_journal_path",
+    "roofline_report",
     "telemetry_flush",
     "telemetry_step",
     "validate_event",

@@ -34,22 +34,31 @@ import os
 import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
-__all__ = ["SCHEMA_VERSION", "EVENT_KINDS", "FAULT_KINDS", "REQUIRED_FIELDS",
+__all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
+           "FAULT_KINDS", "V2_KINDS", "REQUIRED_FIELDS",
            "make_event", "validate_event", "Journal", "read_journal",
-           "resolve_journal_path", "latest_per_epoch", "epoch_series",
-           "append_journal_record"]
+           "read_journal_tail", "resolve_journal_path", "latest_per_epoch",
+           "epoch_series", "append_journal_record"]
 
-SCHEMA_VERSION = 1
+#: v2 (ISSUE 8) adds only new kinds — ``compile`` (the cost ledger's
+#: program introspection) and ``profile`` (overlap-truth trace analysis).
+#: Every v1 event validates verbatim under the v2 reader: the version bump
+#: is additive by design, so pre-v2 journals stay first-class sources.
+SCHEMA_VERSION = 2
+ACCEPTED_VERSIONS = frozenset({1, 2})
 
-#: Every kind a v1 journal may contain.  The five fault kinds keep their
+#: Every kind a journal may contain.  The five fault kinds keep their
 #: historical ``faults.json`` names so the view stays a pure filter.
 FAULT_KINDS = frozenset({
     "plan", "healed", "rollback", "alpha_rederived", "emergency_checkpoint",
 })
+#: Kinds introduced by schema v2 — invalid inside a v1 event (a v1 writer
+#: cannot have produced them; seeing one means the envelope is lying).
+V2_KINDS = frozenset({"compile", "profile"})
 EVENT_KINDS = frozenset({
     "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
     "retrace", "bench",
-}) | FAULT_KINDS
+}) | FAULT_KINDS | V2_KINDS
 
 #: Kind-specific payload keys an event must carry to validate.  Kinds not
 #: listed need only the envelope (v / kind / t).
@@ -65,6 +74,14 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     "checkpoint": frozenset({"epoch", "path"}),
     "retrace": frozenset({"label", "traces"}),
     "bench": frozenset({"record"}),
+    # v2: one per distinct compiled program (obs.costs.CostLedger) — the
+    # extracted cost/footprint ledger the roofline consumes
+    "compile": frozenset({"label", "fingerprint", "compile_seconds",
+                          "flops", "hbm_bytes", "peak_bytes"}),
+    # v2: one per parsed profiler trace (obs.xprof) — executed-kernel
+    # phase attribution and the comm/comp overlap fraction
+    "profile": frozenset({"source", "comm_seconds", "compute_seconds",
+                          "overlap_seconds", "overlap_fraction"}),
 }
 
 
@@ -78,11 +95,14 @@ def validate_event(event: dict) -> List[str]:
     problems: List[str] = []
     if not isinstance(event, dict):
         return [f"event is {type(event).__name__}, not an object"]
-    if event.get("v") != SCHEMA_VERSION:
-        problems.append(f"v={event.get('v')!r} (want {SCHEMA_VERSION})")
+    v = event.get("v")
+    if v not in ACCEPTED_VERSIONS:
+        problems.append(f"v={v!r} (want one of {sorted(ACCEPTED_VERSIONS)})")
     kind = event.get("kind")
     if kind not in EVENT_KINDS:
         problems.append(f"unknown kind {kind!r}")
+    elif kind in V2_KINDS and isinstance(v, int) and v < 2:
+        problems.append(f"{kind} is a v2 kind but event claims v={v}")
     t = event.get("t")
     if not isinstance(t, (int, float)) or not t >= 0:
         problems.append(f"t={t!r} is not a non-negative number")
@@ -165,6 +185,66 @@ def read_journal(path: str, repair: bool = False) -> List[dict]:
             raise ValueError(f"{path}:{lineno}: malformed journal line "
                              f"({e})") from e
     return events
+
+
+def _tail_lines(f, n: int, block: int) -> List[bytes]:
+    """Last ``n`` non-empty lines of an opened binary file, reading only
+    tail blocks (separable from the path plumbing so the boundedness is
+    unit-testable on a counting file object).
+
+    The stop condition counts *usable* lines — non-empty, and excluding
+    the first fragment of the window (potentially a partial line when the
+    window starts mid-file) — so blank separator lines cost extra block
+    reads but can never shrink the result below the ``n`` events the file
+    actually holds."""
+    if n <= 0:
+        return []
+    f.seek(0, os.SEEK_END)
+    pos = f.tell()
+    data = b""
+    while True:
+        lines = data.split(b"\n")
+        # the first fragment may be a partial line when the window starts
+        # mid-file: drop it from consideration entirely
+        usable = lines[1:] if pos > 0 else lines
+        nonempty = [ln for ln in usable if ln.strip()]
+        if pos == 0 or len(nonempty) >= n:
+            return nonempty[-n:]
+        step = min(block, pos)
+        pos -= step
+        f.seek(pos)
+        data = f.read(step) + data
+
+
+def read_journal_tail(path: str, n: int, block: int = 65536) -> List[dict]:
+    """The last ``n`` events of a journal by bounded reverse read.
+
+    ``obs_tpu.py tail`` is a "what just happened" query; loading the whole
+    file makes it O(run length) per invocation — on a long run's journal
+    that is megabytes parsed to print 20 lines.  This reads blocks from
+    the end until ``n`` complete lines are in hand: O(tail bytes).
+
+    Same crash tolerance as ``read_journal(repair=True)``: a malformed
+    **final** line (the partial tail a crash mid-append leaves) is
+    dropped; a malformed line anywhere earlier in the window raises — it
+    is real corruption, and tail must not silently skip over it."""
+    if n <= 0:
+        return []
+    events: List[dict] = []
+    with open(path, "rb") as f:
+        # +1 line of slack: if the final line is a crash-truncated partial,
+        # dropping it must still leave n whole events when they exist
+        lines = _tail_lines(f, n + 1, block)
+    for i, raw in enumerate(lines):
+        try:
+            events.append(json.loads(raw))
+        except json.JSONDecodeError as e:
+            if i == len(lines) - 1:
+                break  # crash-truncated tail: drop it, keep the prefix
+            raise ValueError(
+                f"{path}: malformed journal line in tail window ({e})"
+            ) from e
+    return events[-n:]
 
 
 def resolve_journal_path(source: str) -> str:

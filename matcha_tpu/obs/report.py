@@ -62,6 +62,8 @@ def summarize(events: List[dict]) -> Dict:
     drift = [e for e in events if e.get("kind") == "drift"]
     retrace = [e for e in events if e.get("kind") == "retrace"]
     bench = [e for e in events if e.get("kind") == "bench"]
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    profiles = [e for e in events if e.get("kind") == "profile"]
     total_bytes = sum(r["wire_bytes"] or 0.0 for r in rows) or None
     return {
         "start": start,
@@ -70,6 +72,8 @@ def summarize(events: List[dict]) -> Dict:
         "drift": drift,
         "retrace": retrace,
         "bench": bench,
+        "compile": compiles,
+        "profile": profiles,
         "total_wire_bytes": total_bytes,
         "events_total": len(events),
     }
@@ -125,6 +129,20 @@ def render_summary(events: List[dict], source: str = "events.jsonl") -> str:
                           if k not in ("v", "t", "kind")}
                 lines.append(f"  t={e.get('t', 0):.1f}s {e['kind']}: "
                              f"{json.dumps(detail, sort_keys=True)[:160]}")
+    if digest["compile"]:
+        lines.append(f"compiled programs (cost ledger): "
+                     f"{len(digest['compile'])}")
+        for e in digest["compile"]:
+            lines.append(
+                f"  {e.get('label', '?'):<14} {e.get('fingerprint', '')} "
+                f"compile {_fmt(e.get('compile_seconds'), 3)}s  "
+                f"flops {_fmt(e.get('flops'), 4)}  "
+                f"hbm {_fmt_bytes(e.get('hbm_bytes'))}  "
+                f"peak {_fmt_bytes(e.get('peak_bytes'))}")
+    for e in digest["profile"]:
+        frac = e.get("overlap_fraction")
+        lines.append(f"profile: {os.path.basename(str(e.get('source')))} "
+                     f"overlap {'-' if frac is None else f'{frac:.1%}'}")
     if digest["bench"]:
         lines.append(f"bench records: {len(digest['bench'])}")
     return "\n".join(lines)
@@ -161,6 +179,18 @@ def render_summary_markdown(events: List[dict],
                           if k not in ("v", "t", "kind")}
                 lines.append(f"- `t={e.get('t', 0):.1f}s` **{e['kind']}** "
                              f"`{json.dumps(detail, sort_keys=True)[:200]}`")
+    if digest["compile"]:
+        lines += ["", "## Compiled programs (cost ledger)", "",
+                  "| label | fingerprint | compile s | FLOPs | HBM bytes "
+                  "| peak |",
+                  "|---|---|---:|---:|---:|---:|"]
+        for e in digest["compile"]:
+            lines.append(
+                f"| {e.get('label')} | `{e.get('fingerprint')}` "
+                f"| {_fmt(e.get('compile_seconds'), 3)} "
+                f"| {_fmt(e.get('flops'), 4)} "
+                f"| {_fmt_bytes(e.get('hbm_bytes'))} "
+                f"| {_fmt_bytes(e.get('peak_bytes'))} |")
     lines.append("")
     return "\n".join(lines)
 
@@ -206,6 +236,22 @@ def compare_sources(sources: Sequence[str]) -> Tuple[List[Dict], List[str]]:
             if src.endswith(".json"):
                 with open(src) as f:
                     rec = json.load(f)
+                # MULTICHIP_r*.json: the driver's dryrun_multichip stamp
+                # (in-tree since r1, invisible to this CLI until ISSUE 8) —
+                # n_devices is the comparable number, ok/rc the verdict
+                if "n_devices" in rec and "ok" in rec:
+                    rows.append({
+                        "source": label,
+                        "value": float(rec.get("n_devices") or 0),
+                        "unit": "multichip_dryrun_devices",
+                        "backend": ("skipped" if rec.get("skipped")
+                                    else "ok" if rec.get("ok")
+                                    else f"rc={rec.get('rc')}"),
+                        "vs_baseline": None,
+                        "device_kind": None,
+                        "mfu": None,
+                    })
+                    continue
                 # unwrap the known capture formats: bench_live_r*.json
                 # ({"record": ...}) and the driver's BENCH_r*.json
                 # ({"parsed": ...} with the raw line in "tail")

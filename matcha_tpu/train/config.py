@@ -128,6 +128,14 @@ class TrainConfig:
     # describes); telemetry=False disables it too.
     drift_tolerance: float = 0.25
     drift_patience: int = 2
+    # overlap-truth capture (DESIGN.md §15): when set, exactly one epoch
+    # (trace_epoch, clamped to the run) is wrapped in a jax.profiler trace
+    # written under this directory — the executed-kernel record
+    # `obs_tpu.py profile` parses for the comm/comp overlap fraction.
+    # Epoch 1 by default: epoch 0 would trace the compiles, drowning the
+    # steady-state kernels the overlap question is about.
+    trace_dir: Optional[str] = None
+    trace_epoch: int = 1
     # initial-consensus sync (reference train_mpi.py:97 sync_allreduce).
     # False starts the workers at their independent inits — the
     # consensus-dominant regime drift diagnostics and pure-gossip studies
@@ -196,6 +204,9 @@ class TrainConfig:
                 "communicator (the only compressed one)")
         if self.max_recoveries < 0:
             raise ValueError("max_recoveries must be >= 0")
+        if self.trace_epoch < 0:
+            raise ValueError(
+                f"trace_epoch must be >= 0, got {self.trace_epoch}")
         if not self.drift_tolerance > 0:
             raise ValueError(
                 f"drift_tolerance must be > 0, got {self.drift_tolerance}")

@@ -18,6 +18,7 @@ initial replicas, then runs the epoch loop.  Differences by design:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import time
@@ -28,9 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..communicator import select_communicator
-from ..obs import DriftMonitor, Telemetry, compose_predicted_rho
+from ..obs import CostLedger, DriftMonitor, Telemetry, compose_predicted_rho
 from ..obs.telemetry import make_telemetry_spec, telemetry_flush
-from ..utils import annotate
+from ..utils import annotate, trace
 from ..data import (
     WorkerBatches,
     load_npz,
@@ -289,7 +290,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         step_fn = _make_step(communicator)
         scan_step = _make_epoch_scan(step_fn) if config.scan_epoch else None
         comm_timer = (
-            _make_comm_timer(communicator, flattener)
+            _make_comm_timer(communicator, flattener, ledger=cost_ledger)
             if config.measure_comm_split and config.communicator != "none"
             else None)
         _stages.clear()
@@ -320,7 +321,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             _stages[ratio] = (
                 comm, sf,
                 _make_epoch_scan(sf) if config.scan_epoch else None,
-                _make_comm_timer(comm, flattener)
+                _make_comm_timer(comm, flattener, ledger=cost_ledger)
                 if config.measure_comm_split and config.communicator != "none"
                 else None,
             )
@@ -355,6 +356,12 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
     evaluate = make_eval_fn(model)
     recorder = Recorder(config, config.num_workers)
+    # compiled-cost ledger (DESIGN.md §15): every distinct program this
+    # loop runs is introspected once (.lower().compile().cost_analysis())
+    # and journaled as a v2 `compile` event — FLOPs, boundary HBM bytes,
+    # peak footprint, arg shardings, compile wall-time.  One extra AOT
+    # compile per distinct program, gated with the rest of observability.
+    cost_ledger = CostLedger(recorder.log_event) if config.telemetry else None
     if start_epoch and config.save:
         # re-align the CSV series with the restored epoch: reload the
         # previous run's rows truncated to the checkpoint, so save() extends
@@ -445,6 +452,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     _retrace_flagged: set = set()
     _trace_allowance = (2 if config.scan_chunk else 1) if config.scan_epoch \
         else 1
+    _step_label = "epoch_scan" if config.scan_epoch else "train_step"
 
     def _watch_retrace(fn):
         if not config.telemetry or fn is None:
@@ -453,8 +461,14 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         if count is not None and count > _trace_allowance \
                 and id(fn) not in _retrace_flagged:
             _retrace_flagged.add(id(fn))
-            recorder.log_event("retrace", label="train_step",
-                               traces=int(count))
+            # the cost ledger observed the growth-causing call before it
+            # ran, so "the cache grew" arrives WITH the program that was
+            # added and what it costs (its compile event shares this
+            # fingerprint) — the §15 upgrade of this watch
+            recorder.log_event(
+                "retrace", label=_step_label, traces=int(count),
+                fingerprint=(cost_ledger.last_fingerprint(_step_label)
+                             if cost_ledger is not None else None))
 
     epoch = start_epoch
     while epoch < config.epochs:
@@ -468,20 +482,35 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         stage = _stage_fns(epoch)
         if stage is not None:  # compression-warmup epoch: ramped-ratio programs
             _, e_step, e_scan, e_timer = stage
+        # overlap-truth capture (DESIGN.md §15): exactly one clamped epoch
+        # runs inside a jax.profiler trace window when trace_dir is set;
+        # the epoch-boundary block_until_ready below sits INSIDE the
+        # window so asynchronously dispatched kernels land in the capture
+        # (the utils.profiling.trace contract)
+        tracing = (config.trace_dir is not None
+                   and epoch == min(config.trace_epoch, config.epochs - 1))
         t0 = time.time()
-        if config.scan_epoch:
-            state, epoch_metrics = _run_epoch_scanned(
-                e_scan, state, loader, epoch, rng, config.scan_chunk)
-        else:
-            sums: Dict[str, float] = {}
-            count = 0
-            for xb, yb in loader.epoch(epoch):
-                state, m = e_step(state, jnp.asarray(xb), jnp.asarray(yb), rng)
-                for k, v in m.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
-                count += 1
-            epoch_metrics = {k: v / count for k, v in sums.items()}
-        jax.block_until_ready(state.params)
+        with trace(config.trace_dir) if tracing else contextlib.nullcontext():
+            if config.scan_epoch:
+                state, epoch_metrics = _run_epoch_scanned(
+                    e_scan, state, loader, epoch, rng, config.scan_chunk,
+                    ledger=cost_ledger, label=_step_label)
+            else:
+                sums: Dict[str, float] = {}
+                count = 0
+                for xb, yb in loader.epoch(epoch):
+                    xb, yb = jnp.asarray(xb), jnp.asarray(yb)
+                    if cost_ledger is not None and count == 0:
+                        # once per epoch is enough: batches share a shape,
+                        # and the ledger dedups by program signature anyway
+                        cost_ledger.observe(_step_label, e_step,
+                                            state, xb, yb, rng)
+                    state, m = e_step(state, xb, yb, rng)
+                    for k, v in m.items():
+                        sums[k] = sums.get(k, 0.0) + float(v)
+                    count += 1
+                epoch_metrics = {k: v / count for k, v in sums.items()}
+            jax.block_until_ready(state.params)
         epoch_time = time.time() - t0
 
         if config.halt_on_divergence:
@@ -614,7 +643,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
             eval_batch = config.eval_batch or max(16, 1024 // config.num_workers)
             test_loss, test_acc = _evaluate_in_batches(
-                evaluate, state, dataset.x_test, dataset.y_test, batch=eval_batch
+                evaluate, state, dataset.x_test, dataset.y_test,
+                batch=eval_batch, ledger=cost_ledger
             )
             if faults is not None:
                 # same quarantine exemption as the train-side metrics: a
@@ -697,6 +727,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             return s.replace(params=flattener.unflatten(flat),
                              mix_pending=jnp.zeros_like(s.mix_pending))
 
+        if cost_ledger is not None:
+            cost_ledger.observe("drain", _drain, state)
         state = _drain(state)
     if config.save:
         with annotate("matcha/recorder_flush"):
@@ -746,7 +778,8 @@ def _reconcile_mix_pending(state, overlap: str, communicator, flattener,
     return state.replace(params=flattener.unflatten(flat), mix_pending=())
 
 
-def _make_comm_timer(communicator, flattener, sample_steps: int = 32):
+def _make_comm_timer(communicator, flattener, sample_steps: int = 32,
+                     ledger=None):
     """Jitted gossip-only chain, timed with a forced scalar readback
     (block_until_ready alone is unreliable on tunneled backends — see
     bench.py).
@@ -787,6 +820,12 @@ def _make_comm_timer(communicator, flattener, sample_steps: int = 32):
 
         def timed(m: int) -> float:
             flags = jnp.asarray(flags_window[:m], jnp.float32)
+            if ledger is not None:
+                # the gossip-only chain is a program of the run like any
+                # other: its two window lengths (k, 2k) are two distinct
+                # compiled programs, each costed once on the ledger
+                ledger.observe("gossip_chain", fn,
+                               state.params, state.comm_carry, flags)
             float(fn(state.params, state.comm_carry, flags))  # warm/compile
             t0 = time.time()
             float(fn(state.params, state.comm_carry, flags))
@@ -826,7 +865,8 @@ def _make_epoch_scan(step_fn):
 
 
 def _run_epoch_scanned(scan_step, state, loader: WorkerBatches, epoch: int,
-                       rng, scan_chunk: Optional[int]):
+                       rng, scan_chunk: Optional[int], ledger=None,
+                       label: str = "epoch_scan"):
     """One epoch through the scanned step, whole-epoch or chunk-pipelined.
 
     ``scan_chunk=None`` stages the full ``[steps, N, B, ...]`` stack (the
@@ -837,11 +877,19 @@ def _run_epoch_scanned(scan_step, state, loader: WorkerBatches, epoch: int,
     explicit double-buffering.  Metrics are weighted by segment length, so
     the epoch means are identical to the whole-epoch scan.
     """
+    def observed(s, xs, ys):
+        """Dispatch one scanned segment, after the ledger (when on) has
+        costed its program — a chunked epoch's tail is a second compiled
+        shape and journals its own compile event."""
+        if ledger is not None:
+            ledger.observe(label, scan_step, s, xs, ys, rng)
+        return scan_step(s, xs, ys, rng)
+
     batches = loader.epoch(epoch)
     if not scan_chunk:
         xs, ys = zip(*batches)
-        state, metrics = scan_step(state, jnp.asarray(np.stack(xs)),
-                                   jnp.asarray(np.stack(ys)), rng)
+        state, metrics = observed(state, jnp.asarray(np.stack(xs)),
+                                  jnp.asarray(np.stack(ys)))
         return state, {k: float(np.mean(v)) for k, v in metrics.items()}
 
     sums: Dict[str, float] = {}
@@ -865,15 +913,15 @@ def _run_epoch_scanned(scan_step, state, loader: WorkerBatches, epoch: int,
             # going idle and the next segment's dispatch, or the promised
             # overlap never happens (metrics are not donated, so reading
             # them after the next dispatch is safe)
-            state, metrics = scan_step(state, jnp.asarray(np.stack(seg_x)),
-                                       jnp.asarray(np.stack(seg_y)), rng)
+            state, metrics = observed(state, jnp.asarray(np.stack(seg_x)),
+                                      jnp.asarray(np.stack(seg_y)))
             if pending is not None:
                 flush(*pending)
             pending = (metrics, len(seg_x))
             seg_x, seg_y = [], []
     if seg_x:  # tail segment (its own compiled shape, at most once per run)
-        state, metrics = scan_step(state, jnp.asarray(np.stack(seg_x)),
-                                   jnp.asarray(np.stack(seg_y)), rng)
+        state, metrics = observed(state, jnp.asarray(np.stack(seg_x)),
+                                  jnp.asarray(np.stack(seg_y)))
         if pending is not None:
             flush(*pending)
         pending = (metrics, len(seg_x))
@@ -882,7 +930,8 @@ def _run_epoch_scanned(scan_step, state, loader: WorkerBatches, epoch: int,
     return state, {k: v / total for k, v in sums.items()}
 
 
-def _evaluate_in_batches(evaluate, state, x_test, y_test, batch: int = 512):
+def _evaluate_in_batches(evaluate, state, x_test, y_test, batch: int = 512,
+                         ledger=None):
     """Full-test-set eval (reference test() covers the partial tail batch too,
     util.py:422-432) — at most two compiled shapes: `batch` and the tail."""
     losses, accs, weights = [], [], []
@@ -890,6 +939,9 @@ def _evaluate_in_batches(evaluate, state, x_test, y_test, batch: int = 512):
     for i in splits:
         xl = jnp.asarray(x_test[i : i + batch])
         yl = jnp.asarray(y_test[i : i + batch])
+        if ledger is not None:
+            ledger.observe("evaluate", evaluate,
+                           state.params, state.batch_stats, xl, yl)
         l, a = evaluate(state.params, state.batch_stats, xl, yl)
         losses.append(np.asarray(l))
         accs.append(np.asarray(a))

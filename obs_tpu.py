@@ -26,9 +26,36 @@ Commands
 
 ``compare SRC... [--md PATH]``
     One table across heterogeneous sources: run dirs / journals (their
-    ``bench`` events, or the final telemetry row) and bare
-    ``BENCH_r*.json`` / ``benchmarks/bench_live_r*.json`` records — so
-    pre-journal rounds and journal-emitting rounds land side by side.
+    ``bench`` events, or the final telemetry row), bare
+    ``BENCH_r*.json`` / ``benchmarks/bench_live_r*.json`` records, and
+    ``MULTICHIP_r*.json`` dryrun stamps — so pre-journal rounds and
+    journal-emitting rounds land side by side.
+
+Performance observability (DESIGN.md §15):
+
+``roofline [--workers N] [--dim D | --model M] [--chip C] [--measured R |
+--source SRC] [--md PATH]``
+    The automatic roofline: compile the dense per-step gossip program at
+    the requested shape, extract FLOPs/HBM-bytes from the compiled cost
+    analysis, and emit compute-bound / HBM-bound steps/s ceilings against
+    the pinned chip peaks (CPU gets explicit provisional placeholders) —
+    machine-checking benchmarks/ROOFLINE.md.  ``--measured`` (or a bench
+    record via ``--source``) adds the measured-vs-ceiling ratio the
+    Pallas-promotion gate reads.  Exit 1 when any ceiling is non-finite.
+
+``capacity [--dim D | --model M] [--workers N,N] [--chip C] [--md PATH]``
+    Re-derive the DESIGN.md §9 HBM capacity table from the compiled
+    state-update program's ``memory_analysis()`` instead of hand
+    multiplication: persistent state bytes and chips needed per
+    (communicator, N).
+
+``profile TRACE... [--md PATH] [--journal PATH]``
+    Overlap truth: parse executed ``jax.profiler`` traces (the
+    ``*.trace.json.gz`` a ``--trace-dir`` run or ``utils.profiling.trace``
+    captured), attribute device kernel rows to phases via the ``comm/*`` /
+    ``matcha/*`` named scopes, and report the comm/comp overlap fraction
+    per trace.  Exits 2 with a clear message when a trace has no device
+    rows (a CPU capture) instead of reporting a fake 0%.
 
 ``RUN`` is a run directory (holding ``events.jsonl``) or a journal path.
 """
@@ -59,9 +86,12 @@ def cmd_summary(args) -> int:
 
 
 def cmd_tail(args) -> int:
+    from matcha_tpu.obs import read_journal_tail, resolve_journal_path
     from matcha_tpu.obs.report import render_tail
 
-    events, _ = _load(args.run)
+    # bounded reverse read: "what just happened" must cost O(tail), not
+    # O(run length) — a long run's journal is megabytes of history
+    events = read_journal_tail(resolve_journal_path(args.run), args.n)
     print(render_tail(events, n=args.n))
     return 0
 
@@ -115,6 +145,111 @@ def cmd_compare(args) -> int:
     return 0
 
 
+def _resolve_dim(args) -> int:
+    if args.dim:
+        return args.dim
+    from matcha_tpu.obs.costs import flat_param_dim
+
+    return flat_param_dim(args.model, args.dataset, num_classes=args.classes)
+
+
+def _resolve_measured(args):
+    """Measured steps/s: explicit ``--measured``, or the first rate row a
+    ``--source`` (bench journal / BENCH_r*.json / run dir) yields."""
+    if args.measured is not None:
+        return float(args.measured)
+    if not args.source:
+        return None
+    from matcha_tpu.obs.report import compare_sources
+
+    rows, problems = compare_sources([args.source])
+    for p in problems:
+        print(f"# {p}", file=sys.stderr)
+    for row in rows:
+        if row.get("value") and row.get("unit") == "gossip_steps_per_sec":
+            return float(row["value"])
+    print(f"# no gossip_steps_per_sec record in {args.source}",
+          file=sys.stderr)
+    return None
+
+
+def cmd_roofline(args) -> int:
+    import math
+
+    from matcha_tpu.obs.costs import render_roofline_markdown, roofline_report
+    from matcha_tpu.topology import decompose, graph_size, make_graph, \
+        select_graph
+
+    if args.graphid is not None:
+        decomposed = select_graph(args.graphid)
+        n = graph_size(args.graphid)
+    else:
+        n = args.workers
+        decomposed = decompose(make_graph(args.topology, n, seed=1), n, seed=1)
+    dim = _resolve_dim(args)
+    report = roofline_report(n, dim, decomposed, wire_dtype=args.wire_dtype,
+                             chip=args.chip,
+                             measured_steps_per_sec=_resolve_measured(args))
+    md = render_roofline_markdown(report, source=args.source or "")
+    print(md)
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(md)
+        print(f"# markdown written to {args.md}", file=sys.stderr)
+    ok = all(math.isfinite(report[k]) and report[k] > 0 for k in
+             ("flops_per_step", "hbm_bytes_per_step",
+              "compute_bound_steps_per_sec", "hbm_bound_steps_per_sec"))
+    if args.journal and ok:
+        # gated on finiteness: a failed extraction must not write NaN
+        # tokens (non-strict JSON) into a session journal the compare /
+        # summary renderers will read later
+        from matcha_tpu.obs import append_journal_record
+
+        append_journal_record(args.journal, "bench",
+                              record={"roofline": report,
+                                      "unit": "roofline_report"})
+    if not ok:
+        print("obs_tpu: roofline produced non-finite ceilings (nothing "
+              "journaled)", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def cmd_capacity(args) -> int:
+    from matcha_tpu.obs.costs import capacity_report, render_capacity_markdown
+
+    workers = [int(w) for w in args.workers.split(",") if w.strip()]
+    report = capacity_report(_resolve_dim(args), workers=workers,
+                             communicators=tuple(
+                                 c for c in args.communicators.split(",")
+                                 if c.strip()),
+                             chip=args.chip)
+    md = render_capacity_markdown(report)
+    print(md)
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(md)
+        print(f"# markdown written to {args.md}", file=sys.stderr)
+    return 0
+
+
+def cmd_profile(args) -> int:
+    from matcha_tpu.obs.xprof import profile_report, render_profile_markdown
+
+    reports = [profile_report(src) for src in args.traces]
+    md = render_profile_markdown(reports)
+    print(md)
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(md)
+        print(f"# markdown written to {args.md}", file=sys.stderr)
+    if args.journal:
+        from matcha_tpu.obs import append_journal_record
+
+        for r in reports:
+            append_journal_record(args.journal, "profile", **r)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__,
@@ -143,9 +278,63 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("compare", help="table across runs / bench records")
     s.add_argument("sources", nargs="+",
-                   help="run dirs, journal files, or BENCH_r*.json records")
+                   help="run dirs, journal files, BENCH_r*.json or "
+                        "MULTICHIP_r*.json records")
     s.add_argument("--md", default=None)
     s.set_defaults(fn=cmd_compare)
+
+    def _shape_flags(s):
+        s.add_argument("--dim", type=int, default=0,
+                       help="flat parameter dimension D; 0 derives it from "
+                            "--model via eval_shape (shapes only)")
+        s.add_argument("--model", default="resnet20")
+        s.add_argument("--dataset", default="synthetic_image")
+        s.add_argument("--classes", type=int, default=10)
+        s.add_argument("--chip", default=None,
+                       help="chip table key (v5e, v4, ...); default = the "
+                            "current backend, CPU falls back to explicit "
+                            "provisional placeholders")
+
+    s = sub.add_parser("roofline",
+                       help="compiled-cost ceilings vs chip peaks")
+    _shape_flags(s)
+    s.add_argument("--workers", type=int, default=256,
+                   help="virtual workers N (ignored with --graphid)")
+    s.add_argument("--topology", default="geometric",
+                   help="generator topology (north star: geometric)")
+    s.add_argument("--graphid", type=int, default=None,
+                   help="zoo topology id instead of the generator")
+    s.add_argument("--wire-dtype", default="bf16", choices=["f32", "bf16"],
+                   dest="wire_dtype")
+    s.add_argument("--measured", type=float, default=None,
+                   help="measured steps/s for the vs-ceiling ratio")
+    s.add_argument("--source", default=None,
+                   help="bench journal / BENCH_r*.json / run dir to read "
+                        "the measured rate from instead of --measured")
+    s.add_argument("--md", default=None)
+    s.add_argument("--journal", default=None,
+                   help="also append the report as a bench event here")
+    s.set_defaults(fn=cmd_roofline)
+
+    s = sub.add_parser("capacity",
+                       help="§9 HBM capacity table from memory_analysis()")
+    _shape_flags(s)
+    s.add_argument("--workers", default="256,64",
+                   help="comma-separated worker counts (table rows)")
+    s.add_argument("--communicators", default="decen,choco",
+                   help="comma-separated communicator column set")
+    s.add_argument("--md", default=None)
+    s.set_defaults(fn=cmd_capacity)
+
+    s = sub.add_parser("profile",
+                       help="overlap truth from executed profiler traces")
+    s.add_argument("traces", nargs="+",
+                   help="trace dirs (a --trace-dir capture) or "
+                        "*.trace.json.gz files")
+    s.add_argument("--md", default=None)
+    s.add_argument("--journal", default=None,
+                   help="also append one `profile` event per trace here")
+    s.set_defaults(fn=cmd_profile)
 
     args = p.parse_args(argv)
     try:

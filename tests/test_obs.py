@@ -165,17 +165,24 @@ def test_overlap_bf16_counters_journal(tmp_path):
 
 def test_reference_journal_validates_line_by_line():
     """The committed artifact pins the schema: every line must validate,
-    and the kinds the docs promise must actually occur."""
+    and the kinds the docs promise must actually occur.  Re-pinned at v2
+    (ISSUE 8): the journal now carries the cost ledger's `compile` event
+    for the scanned-epoch program, populated on this CPU backend."""
     events = read_journal(str(REPO / "benchmarks" / "events_ring8.jsonl"))
     assert events, "reference journal is empty"
     for i, e in enumerate(events):
         assert validate_event(e) == [], f"line {i + 1}: {validate_event(e)}"
+    assert {e["v"] for e in events} == {2}
     kinds = {e["kind"] for e in events}
-    assert {"run_start", "epoch", "telemetry"} <= kinds
+    assert {"run_start", "epoch", "telemetry", "compile"} <= kinds
     start = events[0]
     assert start["kind"] == "run_start"
     assert 0.0 < start["predicted"]["rho"] < 1.0
     assert start["predicted"]["steps_per_epoch"] == 4
+    [compile_e] = [e for e in events if e["kind"] == "compile"]
+    assert compile_e["label"] == "epoch_scan"
+    assert compile_e["flops"] > 0 and compile_e["hbm_bytes"] > 0
+    assert compile_e["peak_bytes"] > 0 and compile_e["compile_seconds"] > 0
     # the journal's telemetry series is strictly ordered and parseable
     epochs, d = epoch_series(events, "telemetry", "disagreement_mean")
     assert epochs == sorted(epochs) and len(epochs) >= 6
@@ -187,13 +194,97 @@ def test_validate_event_rejects_drift():
                     disagreement_mean=0.1, disagreement_last=0.1,
                     wire_bytes=1.0, matchings_mean=1.0, alive_mean=8.0)
     assert validate_event(ok) == []
-    assert validate_event({"v": 2, "kind": "telemetry", "t": 0.0})
+    assert validate_event({"v": 3, "kind": "telemetry", "t": 0.0})
     assert any("unknown kind" in p
                for p in validate_event(make_event("nonsense", 0.0)))
     assert any("missing" in p
                for p in validate_event(make_event("drift", 0.0)))
     assert any("t=" in p for p in
                validate_event({"v": 1, "kind": "resume", "t": -1.0}))
+
+
+def test_v1_events_validate_verbatim_and_v2_kinds_are_versioned():
+    """The v1→v2 bump is additive: a v1 writer's events validate under the
+    v2 reader unchanged, the new kinds are in the vocabulary, and a
+    `compile`/`profile` event claiming v=1 is a lying envelope."""
+    from matcha_tpu.obs.journal import EVENT_KINDS, V2_KINDS
+
+    assert V2_KINDS == {"compile", "profile"}
+    assert V2_KINDS <= EVENT_KINDS
+    v1 = {"v": 1, "kind": "resume", "t": 0.5, "epoch": 3}
+    assert validate_event(v1) == []
+    v1_epoch = {"v": 1, "kind": "epoch", "t": 1.0, "epoch": 0,
+                "epoch_time": 1.0, "comp_time": 1.0, "comm_time": 0.0,
+                "train_loss": 2.3, "disagreement": 0.1}
+    assert validate_event(v1_epoch) == []
+    lying = {"v": 1, "kind": "compile", "t": 0.0, "label": "x",
+             "fingerprint": "f", "compile_seconds": 0.1, "flops": 1.0,
+             "hbm_bytes": 1.0, "peak_bytes": 1.0}
+    assert any("v2 kind" in p for p in validate_event(lying))
+    assert validate_event({**lying, "v": 2}) == []
+
+
+def test_read_journal_tail_is_bounded_and_exact(tmp_path):
+    """ISSUE 8 satellite: `tail` must cost O(tail bytes), not O(file).
+    A synthetic 10k-event journal: the bounded reverse read returns
+    exactly the full read's tail while touching only the last blocks."""
+    from matcha_tpu.obs import read_journal_tail
+    from matcha_tpu.obs.journal import _tail_lines
+
+    path = tmp_path / "big.jsonl"
+    with open(path, "w") as f:
+        for i in range(10_000):
+            f.write(json.dumps({"v": 2, "kind": "resume", "t": float(i),
+                                "epoch": i}) + "\n")
+    full = read_journal(str(path))
+    for n in (1, 5, 20, 10_001):
+        assert read_journal_tail(str(path), n) == full[-n:]
+    assert read_journal_tail(str(path), 0) == []
+
+    class CountingFile:
+        def __init__(self, f):
+            self._f = f
+            self.bytes_read = 0
+
+        def seek(self, *a):
+            return self._f.seek(*a)
+
+        def tell(self):
+            return self._f.tell()
+
+        def read(self, n):
+            self.bytes_read += n
+            return self._f.read(n)
+
+    size = path.stat().st_size
+    with open(path, "rb") as raw:
+        cf = CountingFile(raw)
+        lines = _tail_lines(cf, 20, block=4096)
+    assert len(lines) == 20
+    assert cf.bytes_read <= 2 * 4096 < size  # bounded: ~one block of ~500kB
+
+    # blank separator lines cost extra block reads but never shrink the
+    # result below the n events the file actually holds (review finding:
+    # a newline-counting stop condition returned 2 of 5 here)
+    gappy = tmp_path / "gappy.jsonl"
+    with open(gappy, "w") as f:
+        for i in range(10):
+            f.write(json.dumps({"v": 2, "kind": "resume", "t": float(i),
+                                "epoch": i}) + "\n\n\n")
+    got = read_journal_tail(str(gappy), 5, block=32)
+    assert got == read_journal(str(gappy))[-5:] and len(got) == 5
+
+    # crash-truncated final line: dropped, like read_journal(repair=True)
+    with open(path, "a") as f:
+        f.write('{"v": 2, "kind": "ep')
+    tail = read_journal_tail(str(path), 3)
+    assert tail == full[-3:]
+    # malformed line mid-window is corruption: loud
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"v": 1, "kind": "resume", "t": 0.0}\nnot json\n'
+                   '{"v": 1, "kind": "resume", "t": 1.0}\n')
+    with pytest.raises(ValueError, match="malformed journal line"):
+        read_journal_tail(str(bad), 3)
 
 
 def test_run_journal_is_written_and_faults_view_absent(ring8_run):
@@ -565,6 +656,30 @@ def test_cli_compare_mixes_bench_records_and_journals(ring8_run, tmp_path,
     out = capsys.readouterr().out
     assert "123.4" in out and "BENCH_r01.json" in out
     assert obs_tpu.main(["compare", str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_cli_compare_reads_multichip_records(tmp_path, capsys):
+    """ISSUE 8 satellite: the MULTICHIP_r*.json dryrun stamps (in-tree
+    since r1) land in the same compare table — n_devices as the value,
+    ok/rc/skipped as the verdict column."""
+    import obs_tpu
+
+    rc = obs_tpu.main(["compare", str(REPO / "MULTICHIP_r01.json"),
+                       str(REPO / "MULTICHIP_r05.json")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "multichip_dryrun_devices" in out
+    assert out.count(" ok ") >= 2 or out.count("ok") >= 2
+    # a failed dryrun shows its rc instead of a silent ok
+    failed = tmp_path / "MULTICHIP_bad.json"
+    failed.write_text(json.dumps(
+        {"n_devices": 4, "rc": 7, "ok": False, "skipped": False}))
+    skipped = tmp_path / "MULTICHIP_skip.json"
+    skipped.write_text(json.dumps(
+        {"n_devices": 0, "rc": 0, "ok": False, "skipped": True}))
+    assert obs_tpu.main(["compare", str(failed), str(skipped)]) == 0
+    out = capsys.readouterr().out
+    assert "rc=7" in out and "skipped" in out
 
 
 def test_bench_journal_sink_appends_valid_event(tmp_path):

@@ -173,6 +173,14 @@ def parse_args(argv=None) -> TrainConfig:
                         "the drift monitor keeps predicting with the solved "
                         "alpha — the deliberate mis-plan knob for chaos-"
                         "testing drift detection (obs_tpu.py drift)")
+    p.add_argument("--trace-dir", default=None, dest="trace_dir",
+                   help="capture one epoch (--trace-epoch) as a "
+                        "jax.profiler trace under this dir — the executed-"
+                        "kernel record obs_tpu.py profile parses for the "
+                        "comm/comp overlap fraction (DESIGN.md §15)")
+    p.add_argument("--trace-epoch", type=int, default=1, dest="trace_epoch",
+                   help="which epoch to trace (clamped to the run; default "
+                        "1 so compiles don't drown the steady-state window)")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                    help="pin the JAX backend before first use (the container "
                         "sitecustomize overrides JAX_PLATFORMS env vars; a "
@@ -219,6 +227,8 @@ def parse_args(argv=None) -> TrainConfig:
         scan_chunk=args.scan_chunk or None,
         remat=args.remat,
         grad_chunk=args.grad_chunk or None,
+        trace_dir=args.trace_dir,
+        trace_epoch=args.trace_epoch,
     )
     return cfg
 
