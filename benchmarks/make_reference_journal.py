@@ -9,8 +9,13 @@ obs test fixtures use.  Event *timings* (``t``, ``compile_seconds``) are
 wall-clock and differ across regenerations by design; the schema, kind
 sequence, and physics-derived payloads are deterministic (fixed seed).
 
+The run carries a two-event membership churn (w3 leaves at epoch 2 and
+rejoins at epoch 5) so the journal pins the elastic ``membership`` kind —
+two events, both eagerly re-planned, bracketing the 8→7→8 live sets —
+alongside the cost ledger's ``compile`` event from the v1→v2 bump.
+
 Regenerate after a journal schema bump (the v1→v2 bump of ISSUE 8 added
-``compile`` events from the cost ledger):
+``compile`` events from the cost ledger; ISSUE 9 added ``membership``):
 
     JAX_PLATFORMS=cpu python benchmarks/make_reference_journal.py
 """
@@ -37,6 +42,10 @@ def main() -> int:
         warmup=False, momentum=0.0, weight_decay=0.0, matcha=True,
         budget=0.5, seed=3, save=True, sync_init=False, eval_every=0,
         measure_comm_split=False,
+        membership_trace={"name": "ref_churn", "events": [
+            {"kind": "leave", "epoch": 2, "worker": "w3"},
+            {"kind": "rejoin", "epoch": 5, "worker": "w3"},
+        ]},
     )
     # savePath stays the default relative "runs" so the journaled config
     # snapshot carries no machine-specific temp path — run from a tmp cwd

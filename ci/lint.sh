@@ -17,6 +17,11 @@
 #   6. roofline smoke  obs_tpu.py roofline on a tiny MLP ring-4 CPU config
 #                    — compiled-cost extraction must produce finite
 #                    ceilings (exit 1 otherwise) and a markdown artifact
+#   7. elastic lane  elastic membership (join/leave/rejoin churn e2e,
+#                    policy scorer), as pytest (marker: elastic)
+#   8. elasticity smoke  plan_tpu.py elasticity on a 2-event churn trace
+#                    — the scorer must rank the policy grid and emit an
+#                    artifact that passes its own planlint self-check
 #
 # Fast pre-commit variant: lint only what changed vs a ref —
 #
@@ -61,5 +66,25 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python obs_tpu.py roofline \
 # the artifact must be a real markdown report, not an empty touch
 grep -q '^# Automatic roofline' "$ROOFLINE_MD" || rc=1
 rm -f "$ROOFLINE_MD"
+
+echo "== elastic pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m elastic -p no:cacheprovider || rc=1
+
+echo "== elasticity smoke (2-event churn trace, ring-8) =="
+ELASTIC_DIR="$(mktemp -d)"
+cat > "$ELASTIC_DIR/churn.json" <<'JSON'
+{"name": "ci-churn", "events": [
+  {"kind": "leave",  "epoch": 1, "worker": "w3"},
+  {"kind": "rejoin", "epoch": 3, "worker": "w3"}
+]}
+JSON
+# --out arms the scorer's planlint self-check: a failing artifact exits 1
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python plan_tpu.py elasticity \
+    --graphid 5 --budget 0.5 \
+    --trace "$ELASTIC_DIR/churn.json" --epochs 5 --steps-per-epoch 8 \
+    --mc-trials 2 --out "$ELASTIC_DIR/elasticity_plan.json" \
+    >/dev/null || rc=1
+rm -rf "$ELASTIC_DIR"
 
 exit $rc

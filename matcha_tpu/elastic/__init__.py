@@ -1,0 +1,50 @@
+"""Elastic membership: online join/leave/rejoin for the static worker pool.
+
+Host half (:mod:`.membership`): declarative churn traces, the slot-pool
+reconciler, and the epoch-boundary controller that re-folds the schedule
+over each new live set.  Device half (:mod:`.runtime`): the ``Membership``
+step input (no-retrace contract) and the jitted join/rejoin bootstrap.
+Offline half (:mod:`.policy`): score elasticity policies against a churn
+trace before committing to one (``plan_tpu.py elasticity``).
+"""
+
+from .membership import (
+    MEMBERSHIP_KINDS,
+    ElasticController,
+    MembershipEvent,
+    MembershipTrace,
+    MembershipTransition,
+    MembershipView,
+    load_membership_trace,
+)
+from .runtime import (
+    Membership,
+    freeze_worker_rows,
+    make_bootstrap_fn,
+    membership_arrays,
+)
+
+__all__ = [
+    "MEMBERSHIP_KINDS",
+    "ElasticController",
+    "Membership",
+    "MembershipEvent",
+    "MembershipTrace",
+    "MembershipTransition",
+    "MembershipView",
+    "freeze_worker_rows",
+    "load_membership_trace",
+    "make_bootstrap_fn",
+    "membership_arrays",
+    "score_elasticity_policies",
+]
+
+
+def __getattr__(name):
+    # policy.py pulls in the spectral/solver stack — deferred so the train
+    # loop's elastic import stays light (same pattern as matcha_tpu.plan)
+    if name == "score_elasticity_policies":
+        from .policy import score_elasticity_policies
+
+        return score_elasticity_policies
+    raise AttributeError(name)

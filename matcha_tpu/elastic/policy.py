@@ -1,0 +1,265 @@
+"""Offline elasticity-policy scoring: pick the churn response before churn.
+
+The runtime exposes two knobs (``TrainConfig.membership_hysteresis`` /
+``membership_bootstrap``) whose right setting depends on the churn pattern:
+
+* **re-plan eagerly vs. hysteresis K** — re-deriving α at every membership
+  change keeps the mixing optimal for the current live set, but under
+  rapid join/leave flapping each re-plan re-bases the drift monitor and
+  (with the old α often near the new one) buys little; deferring the fold
+  until the membership holds still for K epochs runs a slightly-wrong α in
+  the interim.
+* **bootstrap-from-mean vs. restore-own-rows** — a rejoiner that restores
+  its own quarantined rows keeps real training state but re-injects its
+  departure-time disagreement; bootstrapping from the survivor mean starts
+  at consensus but discards the worker's history.
+
+``score_elasticity_policies`` plays a declared :class:`MembershipTrace`
+against every policy combination with the **same MC flag-stream simulator
+the planner already trusts** (``schedule.base.sample_flags`` — the exact
+generator training draws from), applying the realized masked mixing
+``W_t = I − α_e·Σ_j flag_j·L_j^masked`` to synthetic worker vectors: frozen
+rows ride identity self-loops exactly as the executor's masked gossip
+realizes them, joins are bootstrapped per policy, and the live-set
+consensus error (``plan.spectral.masked_consensus_error``) is tracked per
+epoch.  The output is a ``matcha_tpu.plan/1`` artifact — the same format
+family ``planlint`` numerically verifies — whose candidates are the
+policies, ranked by mean post-churn consensus error.
+
+Everything here is host-side numpy: a laptop scores churn for a pod
+(``plan_tpu.py elasticity``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .membership import MembershipTrace
+
+__all__ = ["score_elasticity_policies", "elasticity_artifact"]
+
+
+def _policy_grid(hysteresis: Sequence[int]) -> List[Dict]:
+    out = []
+    for h in hysteresis:
+        for bootstrap in ("mean", "restore"):
+            out.append({"hysteresis": int(h), "bootstrap": bootstrap,
+                        "replan": "eager" if h == 0 else f"hysteresis-{h}"})
+    return out
+
+
+def _replay_occupancy(trace: MembershipTrace, size: int, epochs: int):
+    """Per-epoch (alive, joined, restored, eventful) from the trace — the
+    same deterministic replay the runtime controller performs.
+
+    ``eventful`` is the boundary-had-declared-events flag, NOT an
+    alive-mask diff: a full-pool leave+join at one epoch (slot recycled)
+    or a same-epoch leave+rejoin leaves the mask unchanged while the
+    controller still bootstraps the entrant and restarts hysteresis — the
+    sim must gate on what the controller gates on."""
+    view = trace.start_view(size)
+    alive = np.zeros((epochs, size), np.float64)
+    joined = np.zeros((epochs, size), np.float64)
+    restored = np.zeros((epochs, size), np.float64)
+    eventful = np.zeros(epochs, bool)
+    for e in range(epochs):
+        events = trace.at_epoch(e)
+        if events:
+            j, r = view.apply(events)
+            joined[e], restored[e] = j, r
+            eventful[e] = True
+        alive[e] = view.alive_mask()
+    return alive, joined, restored, eventful
+
+
+def score_elasticity_policies(
+    decomposed,
+    size: int,
+    budget: float,
+    trace: MembershipTrace,
+    seed: int = 9001,
+    epochs: Optional[int] = None,
+    steps_per_epoch: int = 16,
+    trials: int = 4,
+    dim: int = 4,
+    hysteresis: Sequence[int] = (0, 2),
+    solver_iters: int = 3000,
+) -> Dict:
+    """Score every (re-plan, bootstrap) policy against one churn trace.
+
+    Returns ``{"pool": {...solver outputs...}, "policies": [...ranked...],
+    "sim": {...}}``; each policy entry carries its per-epoch live-set
+    consensus-error curve (log-mean over trials), the post-churn mean
+    error (the ranking score — lower mixes better through the same churn),
+    and the α the policy was executing per epoch.  Restore-vs-mean only
+    differs where the trace actually rejoins; eager-vs-hysteresis only
+    where it re-plans — identical scores for a trace without those events
+    are a property, not a bug.
+    """
+    from ..plan.spectral import (
+        masked_consensus_error,
+        masked_laplacian_expectation,
+    )
+    from ..schedule.base import refold_mixing, sample_flags
+    from ..schedule.solvers import (
+        solve_activation_probabilities,
+        solve_mixing_weight,
+    )
+    from ..topology import matching_laplacians
+
+    if epochs is None:
+        epochs = max(trace.horizon() + 3, 4)
+    epochs = int(epochs)
+    Ls = matching_laplacians(decomposed, size)
+    probs = solve_activation_probabilities(Ls, budget, iters=solver_iters)
+    alpha0, rho0 = solve_mixing_weight(Ls, probs)
+    alive, joined, restored, eventful = _replay_occupancy(trace, size,
+                                                          epochs)
+    last_change = max([e for e in range(epochs) if e == 0 or eventful[e]],
+                      default=0)
+
+    # α re-folds and masked Laplacian stacks are pure functions of the live
+    # set — memoized across policies/trials so the solver and the masking
+    # each run once per distinct occupancy
+    fold_cache: Dict[bytes, float] = {}
+    mask_cache: Dict[bytes, np.ndarray] = {}
+
+    def masked_stack(mask: np.ndarray) -> np.ndarray:
+        key = mask.astype(np.uint8).tobytes()
+        if key not in mask_cache:
+            mask_cache[key] = masked_laplacian_expectation(Ls, mask)
+        return mask_cache[key]
+
+    def fold_alpha(mask: np.ndarray) -> float:
+        key = mask.astype(np.uint8).tobytes()
+        if key not in fold_cache:
+            # the runtime's own fold (Schedule.refold_for delegates to the
+            # same function): the α the scorer ranks by IS the α the
+            # controller would execute
+            a, _, _ = refold_mixing(Ls, probs, alpha0, mask)
+            fold_cache[key] = float(a)
+        return fold_cache[key]
+
+    policies = _policy_grid(hysteresis)
+    eye = np.eye(size)
+    for pol in policies:
+        curves = np.zeros((trials, epochs), np.float64)
+        alpha_by_epoch = np.zeros(epochs, np.float64)
+        for trial in range(trials):
+            rng = np.random.default_rng(seed * 7919 + trial)
+            flags = sample_flags(probs, epochs * steps_per_epoch,
+                                 seed=seed * 7919 + trial)
+            x = rng.standard_normal((size, dim))
+            x -= x.mean(axis=0, keepdims=True)
+            cur_alpha = alpha0
+            pending_since: Optional[int] = (
+                0 if alive[0].sum() < size else None)
+            for e in range(epochs):
+                changed = bool(eventful[e])
+                if changed or (e == 0 and pending_since == 0):
+                    if changed:
+                        pending_since = e
+                    # bootstrap (re)entering rows BEFORE the epoch runs —
+                    # the runtime's boundary order.  "mean" overwrites every
+                    # entrant with the donors' average; "restore" leaves
+                    # rejoined rows at their frozen leave-time values (the
+                    # runtime's restore-own-rows path) and means only the
+                    # fresh joins.
+                    mean_in = (np.clip(joined[e] + restored[e], 0, 1)
+                               if pol["bootstrap"] == "mean" else joined[e])
+                    # graftlint: disable=GL001 — mask∘mask algebra (all
+                    # three are 0/1 occupancy masks), not a masked value
+                    donors = (alive[e] * (1.0 - joined[e])
+                              * (1.0 - restored[e]))
+                    if mean_in.any() and donors.sum() >= 1:
+                        dmean = x[donors > 0].mean(axis=0)
+                        x = np.where(mean_in[:, None] > 0, dmean[None, :], x)
+                if pending_since is not None and \
+                        e - pending_since >= pol["hysteresis"]:
+                    cur_alpha = fold_alpha(alive[e])
+                    pending_since = None
+                if trial == 0:
+                    alpha_by_epoch[e] = cur_alpha
+                # masked per-matching Laplacians for this epoch's live set
+                # (0/1 mask ⇒ the expectation IS the realized masking)
+                mLs = masked_stack(alive[e])
+                for t in range(e * steps_per_epoch, (e + 1) * steps_per_epoch):
+                    W = eye - cur_alpha * np.tensordot(
+                        flags[t].astype(np.float64), mLs, axes=1)
+                    x = W @ x
+                curves[trial, e] = masked_consensus_error(x, alive[e])
+        log_curve = np.log(np.maximum(curves, 1e-300)).mean(axis=0)
+        post = log_curve[last_change:]
+        pol["error_curve"] = [float(v) for v in np.exp(log_curve)]
+        pol["alpha_by_epoch"] = [float(v) for v in alpha_by_epoch]
+        pol["score"] = float(np.exp(post.mean()))
+        pol["final_error"] = float(math.exp(log_curve[-1]))
+
+    policies.sort(key=lambda p: (p["score"], p["hysteresis"],
+                                 p["bootstrap"]))
+    return {
+        "pool": {"num_workers": int(size), "budget": float(budget),
+                 "seed": int(seed), "alpha": float(alpha0),
+                 "rho": float(rho0),
+                 "probs": [float(p) for p in probs]},
+        "policies": policies,
+        "sim": {"epochs": epochs, "steps_per_epoch": int(steps_per_epoch),
+                "trials": int(trials), "dim": int(dim),
+                "last_change_epoch": int(last_change),
+                "trace": trace.to_json()},
+    }
+
+
+def elasticity_artifact(report: Dict, graph_spec: Dict,
+                        target: float = 1e-3):
+    """Wrap a :func:`score_elasticity_policies` report as a
+    ``matcha_tpu.plan/1`` artifact — the committed, ``planlint``-verifiable
+    form (``lint_tpu.py lint-plan`` re-derives every solver claim in it).
+
+    Every candidate shares the pool schedule (same graph/budget/seed/α/ρ —
+    policies don't change the schedule, only the response to churn), so
+    the numeric checks PL002–PL007 apply verbatim; the policy itself and
+    its churn scores ride as extra keys, and the ranking score lands in
+    ``predicted_seconds_to_target`` — the field PL008 ranks by — so
+    ``chosen`` provably ranks first under the format's own order.
+    """
+    from ..plan.artifact import PlanArtifact
+    from ..plan.spectral import steps_to_consensus
+
+    pool = report["pool"]
+    base = {
+        **graph_spec,
+        "num_workers": pool["num_workers"],
+        "budget": pool["budget"],
+        "seed": pool["seed"],
+        "matcha": True,
+        "alpha": pool["alpha"],
+        "rho": pool["rho"],
+        "probs": list(pool["probs"]),
+        "steps_to_target": (None if pool["rho"] >= 1.0
+                            else steps_to_consensus(pool["rho"], target)),
+        "expected_comm_fraction": float(np.mean(pool["probs"])),
+    }
+    candidates = []
+    for pol in report["policies"]:
+        candidates.append({
+            **base,
+            "predicted_seconds_to_target": pol["score"],
+            "policy": {"replan": pol["replan"],
+                       "hysteresis": pol["hysteresis"],
+                       "bootstrap": pol["bootstrap"]},
+            "elasticity": {"score": pol["score"],
+                           "final_error": pol["final_error"],
+                           "error_curve": pol["error_curve"],
+                           "alpha_by_epoch": pol["alpha_by_epoch"]},
+        })
+    return PlanArtifact(
+        chosen=dict(candidates[0]),
+        candidates=candidates,
+        target_consensus=float(target),
+        num_chips=1,
+        cost_model={"kind": "elasticity", "sim": report["sim"]},
+    )

@@ -1,0 +1,156 @@
+"""Device-side elastic membership: the step input and the boundary surgery.
+
+Two halves, mirroring the resilience split (static plan ↔ in-step masks):
+
+* :class:`Membership` is the **step input** — a tiny pytree riding
+  ``TrainState.membership`` (``alive: f32[N_pool]``, ``alpha_scale: f32``)
+  whose *values* change at epoch boundaries while its shapes never do.
+  This is the whole no-retrace contract: the compiled epoch program takes
+  the pool mask and the re-derived mixing weight as data, so a membership
+  change is an array update, not a recompile.  The scale multiplies the
+  activation-flag row before the communicator (every backend's per-step
+  weight is ``α·flag_j``, so scaling flags by ``α'/α`` executes α′ exactly
+  — dense, gather, skip, and folded alike).
+
+* :func:`make_bootstrap_fn` is the **boundary surgery** — one jitted
+  program (compiled once; every transition reuses it) that maps (re)joining
+  workers into the pool: ``joined`` rows adopt the continuing members'
+  parameter mean and normalization statistics (the same donor arithmetic as
+  ``resilience.runtime.heal_worker_stat_rows``); ``restored`` rows keep
+  their own quarantined parameters *if still finite*, falling back to the
+  mean otherwise; momentum, CHOCO carry, and any in-flight overlap delta
+  are reset for both — stale algorithm state does not survive re-entry.
+
+:func:`freeze_worker_rows` is the in-step complement: a vacant slot's rows
+are frozen at their leave-time values (``where``, never a multiply — the
+row being skipped is exactly the one that might hold a NaN), so a later
+rejoin restores the state the worker actually left with, not the wreckage
+of N epochs of un-mixed solo SGD.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..parallel import masked_mean_rows
+from ..resilience.runtime import (
+    finite_rows,
+    heal_worker_stat_rows,
+    mask_worker_rows,
+)
+
+__all__ = ["Membership", "membership_arrays", "freeze_worker_rows",
+           "make_bootstrap_fn"]
+
+
+class Membership(struct.PyTreeNode):
+    """The membership step input (DESIGN.md §16).
+
+    ``alive``: f32[N_pool] pool-occupancy mask — multiplies into the gossip
+    survivor mask, so every realized mixing matrix is doubly stochastic
+    over the *live* set (the masked-Laplacian property PR 3 proved).
+    ``alpha_scale``: f32 scalar — executed α ÷ schedule-built α; the epoch
+    program multiplies it into the flag row, making the re-derived mixing
+    weight a runtime value.
+    """
+
+    alive: jax.Array
+    alpha_scale: jax.Array
+
+    @classmethod
+    def fresh(cls, num_workers: int) -> "Membership":
+        return cls(alive=jnp.ones((num_workers,), jnp.float32),
+                   alpha_scale=jnp.ones((), jnp.float32))
+
+
+def membership_arrays(alive: np.ndarray, alpha_scale: float) -> Membership:
+    """Host mask + scale → the device pytree the next epoch will consume."""
+    return Membership(
+        alive=jnp.asarray(np.asarray(alive, np.float32)),
+        alpha_scale=jnp.asarray(float(alpha_scale), jnp.float32),
+    )
+
+
+def freeze_worker_rows(new_tree: Any, old_tree: Any, member: jax.Array,
+                       num_workers: int) -> Any:
+    """Keep only member rows from ``new_tree``; vacant slots hold their
+    ``old_tree`` values.
+
+    Applied to every per-worker piece of the state at the end of an elastic
+    step: the SPMD program cannot *not* compute a vacant slot's forward/
+    backward (static shapes), so its updates are computed and then
+    discarded here.  ``where``, not a multiply-blend: the frozen row may be
+    the one non-finite thing in the state and ``0·NaN = NaN`` would thaw
+    it.  Leaves without a worker-major axis (step counters, PRNG keys)
+    pass through from ``new_tree`` untouched.
+    """
+    member_col = {}  # per-ndim broadcast cache, built lazily
+
+    def one(new, old):
+        if not (hasattr(new, "ndim") and new.ndim >= 1
+                and new.shape[0] == num_workers
+                and jnp.issubdtype(new.dtype, jnp.inexact)):
+            return new
+        m = member_col.get(new.ndim)
+        if m is None:
+            m = member.reshape((num_workers,) + (1,) * (new.ndim - 1))
+            member_col[new.ndim] = m
+        return jnp.where(m > 0, new, old)
+
+    return jax.tree_util.tree_map(one, new_tree, old_tree)
+
+
+def make_bootstrap_fn(flattener, num_workers: int):
+    """Build the jitted boundary-surgery program ``bootstrap(state, joined,
+    restored, donors) -> state``.
+
+    ``joined``/``restored``/``donors`` are f32[N_pool] slot masks from
+    :meth:`MembershipView.apply` / :meth:`ElasticController.reconcile_restored`
+    — runtime arrays, so one compiled program serves every transition of
+    the run (and the retrace ledger shows exactly one ``bootstrap`` entry).
+
+    Heal rule: ``joined`` rows and any ``restored`` row that went
+    non-finite while quarantined take the donors' mean; the donor mean
+    itself must exist and be finite (the same quorum guard as
+    ``resilience.runtime.heal_and_mask`` — an empty donor set must not
+    silently zero a joining replica).  BatchNorm statistics follow the
+    parameters (variance cannot be zero-reset); momentum / communicator
+    carry / in-flight overlap delta rows reset for every (re)entered slot —
+    the stale delta a leaver left behind is dropped with them.
+    """
+    n = int(num_workers)
+
+    @jax.jit
+    def bootstrap(state, joined, restored, donors):
+        flat = flattener.flatten(state.params)
+        finite = finite_rows(flat)
+        # a restored row that rotted (non-finite while vacant) falls back
+        # to the mean; clip keeps the mask 0/1 under overlapping inputs
+        # graftlint: disable=GL001 — mask∘mask algebra (restored and
+        # finite are 0/1 slot masks), not a value being masked
+        fallback = jnp.clip(restored * (1.0 - finite), 0.0, 1.0)
+        want_mean = jnp.clip(joined + fallback, 0.0, 1.0)
+        mean = masked_mean_rows(flat, donors)
+        can = (jnp.sum(donors) > 0) & jnp.all(jnp.isfinite(mean))
+        healed = want_mean * can.astype(jnp.float32)
+        hmask = healed.reshape((n,) + (1,) * (flat.ndim - 1))
+        flat = jnp.where(hmask > 0, jnp.broadcast_to(mean, flat.shape), flat)
+        params = flattener.unflatten(flat)
+        stats = heal_worker_stat_rows(state.batch_stats, healed, donors, n)
+        touched = jnp.clip(joined + restored, 0.0, 1.0)
+        keep = 1.0 - touched
+        opt_state = mask_worker_rows(state.opt_state, keep, n)
+        carry = mask_worker_rows(state.comm_carry, keep, n)
+        pend = state.mix_pending
+        if hasattr(pend, "shape"):  # trace-time: () when overlap is off
+            pend = mask_worker_rows(pend, keep, n)
+        return state.replace(params=params, batch_stats=stats,
+                             opt_state=opt_state, comm_carry=carry,
+                             mix_pending=pend)
+
+    return bootstrap

@@ -219,9 +219,13 @@ def drift_report(
     # re-base at the same epochs or its verdict diverges from what the
     # run was actually held to.  An explicit rho override is a what-if
     # and wins over everything.
+    # `membership` re-plans (elastic join/leave/rejoin, §16) re-base the
+    # live monitor exactly like fault-recovery α re-derivations — deferred
+    # (hysteresis) membership events carry an empty `predicted` and are
+    # skipped here, matching the live monitor, which did not re-base either
     rebases = [] if explicit_rho else sorted(
         ((int(e["epoch"]), e["predicted"]) for e in events
-         if e.get("kind") in ("alpha_rederived", "resume")
+         if e.get("kind") in ("alpha_rederived", "resume", "membership")
          and isinstance(e.get("predicted"), dict)
          and e["predicted"].get("rho") is not None
          and "epoch" in e),

@@ -54,7 +54,10 @@ FAULT_KINDS = frozenset({
 })
 #: Kinds introduced by schema v2 — invalid inside a v1 event (a v1 writer
 #: cannot have produced them; seeing one means the envelope is lying).
-V2_KINDS = frozenset({"compile", "profile"})
+#: ``membership`` (ISSUE 9) joins additively: elastic join/leave/rejoin
+#: reconciliations at epoch boundaries, carrying the re-derived α/ρ so
+#: drift replay re-bases exactly where the live monitor did.
+V2_KINDS = frozenset({"compile", "profile", "membership"})
 EVENT_KINDS = frozenset({
     "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
     "retrace", "bench",
@@ -82,6 +85,12 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # phase attribution and the comm/comp overlap fraction
     "profile": frozenset({"source", "comm_seconds", "compute_seconds",
                           "overlap_seconds", "overlap_fraction"}),
+    # v2 (ISSUE 9): one per elastic-membership reconciliation — the old and
+    # new live sets, what triggered the change, and the α/ρ the schedule
+    # was re-folded to (``replanned`` False while hysteresis defers the
+    # fold; ``predicted`` carries the re-based composition for drift replay)
+    "membership": frozenset({"epoch", "old_alive", "new_alive", "trigger",
+                             "alpha", "rho", "replanned"}),
 }
 
 

@@ -59,6 +59,11 @@ def summarize(events: List[dict]) -> Dict:
             "comm_time": p.get("comm_time"),
         })
     faults = [e for e in events if e.get("kind") in FAULT_KINDS]
+    # same reader-side dedupe as telemetry/epoch: a crash-resume replays
+    # its boundary reconciliation, journaling the transition again —
+    # keep the latest per epoch, in epoch order
+    membership = [e for _, e in
+                  sorted(latest_per_epoch(events, "membership").items())]
     drift = [e for e in events if e.get("kind") == "drift"]
     retrace = [e for e in events if e.get("kind") == "retrace"]
     bench = [e for e in events if e.get("kind") == "bench"]
@@ -69,6 +74,7 @@ def summarize(events: List[dict]) -> Dict:
         "start": start,
         "rows": rows,
         "faults": faults,
+        "membership": membership,
         "drift": drift,
         "retrace": retrace,
         "bench": bench,
@@ -120,6 +126,15 @@ def render_summary(events: List[dict], source: str = "events.jsonl") -> str:
                 f"{_fmt(r['comm_time'], 3):>8}")
         lines.append(f"total wire bytes: "
                      f"{_fmt_bytes(digest['total_wire_bytes'])}")
+    for e in digest["membership"]:
+        lives = (int(sum(e.get("old_alive", []))),
+                 int(sum(e.get("new_alive", []))))
+        trig = ",".join(f"{t.get('kind')}:{t.get('worker')}"
+                        for t in e.get("trigger", []))
+        lines.append(
+            f"membership @e{e.get('epoch')}: {lives[0]}→{lives[1]} live "
+            f"[{trig}] alpha={_fmt(e.get('alpha'))} rho={_fmt(e.get('rho'))}"
+            f"{'' if e.get('replanned') else ' (re-plan deferred)'}")
     for label, key in (("fault events", "faults"), ("drift events", "drift"),
                        ("retrace events", "retrace")):
         if digest[key]:
@@ -170,8 +185,8 @@ def render_summary_markdown(events: List[dict],
         lines.append("")
         lines.append(f"Total wire bytes: "
                      f"**{_fmt_bytes(digest['total_wire_bytes'])}**")
-    for label, key in (("Fault", "faults"), ("Drift", "drift"),
-                       ("Retrace", "retrace")):
+    for label, key in (("Fault", "faults"), ("Membership", "membership"),
+                       ("Drift", "drift"), ("Retrace", "retrace")):
         if digest[key]:
             lines += ["", f"## {label} events", ""]
             for e in digest[key]:

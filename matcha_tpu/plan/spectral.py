@@ -42,6 +42,7 @@ __all__ = [
     "simulate_consensus",
     "empirical_contraction_rate",
     "steps_to_consensus",
+    "masked_consensus_error",
     "masked_laplacian_expectation",
     "degraded_contraction_rho",
     "degraded_solver_inputs",
@@ -49,6 +50,24 @@ __all__ = [
     "wire_disagreement_floor",
     "wire_quantization_eps",
 ]
+
+
+def masked_consensus_error(x: np.ndarray, alive: np.ndarray) -> float:
+    """Squared consensus error of the *live* rows: ``Σ_live ‖x_i − x̄_live‖²``.
+
+    The offline twin of the executor's masked ``worker_disagreement`` (and
+    of what masked gossip actually contracts): vacant/dead rows neither
+    define the mean nor count toward the error — a full-pool measure would
+    be pinned by frozen rows regardless of how well the survivors mix.
+    Zero when fewer than two rows are live (no consensus process exists).
+    """
+    x = np.asarray(x, np.float64)
+    keep = np.asarray(alive, np.float64) > 0
+    if int(keep.sum()) < 2:
+        return 0.0
+    live = x[keep]
+    centered = live - live.mean(axis=0, keepdims=True)
+    return float(np.sum(centered * centered))
 
 
 def wire_quantization_eps(wire_dtype) -> float:

@@ -239,7 +239,8 @@ def load_fault_plan(
     return FaultPlan(events=tuple(spec))
 
 
-def resolve_degraded_alpha(schedule, faults: RuntimeFaults):
+def resolve_degraded_alpha(schedule, faults: RuntimeFaults,
+                           worker_alive=None):
     """Re-solve the mixing weight α for a degraded fleet.
 
     The solver inputs are the *expected* masked Laplacians (edges scaled by
@@ -250,15 +251,26 @@ def resolve_degraded_alpha(schedule, faults: RuntimeFaults):
     finally wired into ``solve_mixing_weight`` at run time rather than only
     in offline studies.
 
+    ``worker_alive`` composes an additional availability on top of the
+    fault plan's expectation (elastic membership's pool occupancy,
+    DESIGN.md §16: a vacant slot is dead to the mixing whatever the fault
+    plan thought of it) — the same multiplicative rule the drift monitor's
+    predicted ρ uses.
+
     Returns ``(alpha, rho, p_eff)``; with fewer than two (even fractional)
     survivors the original α is kept (there is no consensus to optimize).
     """
     from ..plan.spectral import degraded_solver_inputs
     from ..schedule.solvers import solve_mixing_weight
 
+    alive = np.asarray(faults.expected_alive(), np.float64)
+    if worker_alive is not None:
+        # graftlint: disable=GL001 — mask∘mask algebra on availability
+        # expectations, not a masked value
+        alive = alive * np.asarray(worker_alive, np.float64)
     Ls, p_eff = degraded_solver_inputs(
         schedule.laplacians(), schedule.probs,
-        worker_alive=faults.expected_alive(),
+        worker_alive=alive,
         link_up=faults.expected_link_up())
     if Ls.shape[-1] < 2:
         return float(schedule.alpha), 1.0, p_eff

@@ -33,7 +33,30 @@ from ..topology import (
 )
 from .solvers import contraction_rho
 
-__all__ = ["Schedule", "sample_flags"]
+__all__ = ["Schedule", "refold_mixing", "sample_flags"]
+
+
+def refold_mixing(laplacians: np.ndarray, probs: np.ndarray, alpha0: float,
+                  worker_alive: np.ndarray):
+    """THE degraded fold rule: ``(α, ρ, p_eff)`` over a partial live set.
+
+    One function on purpose — ``Schedule.refold_for`` (the runtime
+    epoch-boundary re-plan) and the offline elasticity-policy scorer
+    (``elastic.policy``) both call it, so the α the scorer ranks policies
+    by is definitionally the α the runtime would execute.  Fewer than two
+    live workers keeps ``alpha0`` and reports ρ = 1 (no consensus process
+    remains to optimize).
+    """
+    from ..plan.spectral import degraded_solver_inputs
+    from .solvers import solve_mixing_weight
+
+    Ls, p_eff = degraded_solver_inputs(
+        laplacians, probs,
+        worker_alive=np.asarray(worker_alive, np.float64))
+    if Ls.shape[-1] < 2:
+        return float(alpha0), 1.0, p_eff
+    alpha, rho = solve_mixing_weight(Ls, p_eff)
+    return float(alpha), float(rho), p_eff
 
 
 def sample_flags(
@@ -132,6 +155,25 @@ class Schedule:
     def expected_comm_fraction(self) -> float:
         """E[#active matchings] / M — the realized communication budget."""
         return float(np.mean(self.probs))
+
+    def refold_for(self, worker_alive: np.ndarray):
+        """Re-solve ``(α, ρ, p_eff)`` for a partial live set over *this*
+        schedule's matchings — the epoch-boundary re-plan of elastic
+        membership (DESIGN.md §16).
+
+        MATCHA's matching decomposition is what makes this cheap: the
+        permutations (and with them the compiled communication pattern)
+        persist across membership changes; only the expected mixing they
+        realize is re-folded.  The solver inputs are the alive-masked
+        expected Laplacians with fully-dead workers projected out
+        (``plan.spectral.degraded_solver_inputs`` — the exact rule the
+        masked executor realizes), so the returned α minimizes ρ for the
+        consensus process the *survivors* actually run.  With fewer than
+        two live workers the built α is kept and ρ = 1 (no process left
+        to optimize).
+        """
+        return refold_mixing(self.laplacians(), self.probs, self.alpha,
+                             worker_alive)
 
     def slice(self, start: int, stop: int) -> "Schedule":
         """A view of steps [start, stop) — used for epoch-chunked scans."""

@@ -30,7 +30,7 @@ import orbax.checkpoint as ocp
 from .state import TrainState
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "schedule_fingerprint"]
+           "schedule_fingerprint", "load_membership_sidecar"]
 
 
 def _manager(directory: str) -> ocp.CheckpointManager:
@@ -68,14 +68,36 @@ def _sidecar_path(directory: str, epoch: int) -> str:
     return os.path.join(os.path.abspath(directory), f"schedule-{epoch}.json")
 
 
+def _membership_sidecar_path(directory: str, epoch: int) -> str:
+    return os.path.join(os.path.abspath(directory),
+                        f"membership-{epoch}.json")
+
+
+def load_membership_sidecar(directory: str, epoch: int):
+    """The membership view recorded next to checkpoint ``epoch`` — pool
+    occupancy (slot → worker id / last owner) plus the α scale that was
+    executing — or ``None`` for pre-elastic checkpoints (every slot
+    occupied, scale 1: exactly what a non-elastic run is)."""
+    path = _membership_sidecar_path(directory, int(epoch))
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def save_checkpoint(directory: str, state: TrainState, epoch: int,
-                    schedule=None) -> None:
-    # telemetry is per-epoch scratch (DESIGN.md §14) and is stripped HERE,
-    # not at call sites: checkpoint pytrees must be identical with
-    # telemetry on or off, and an invariant every caller has to remember
-    # is an invariant that eventually breaks.  restore_checkpoint strips
-    # its template symmetrically.
-    state = state.replace(telemetry=())
+                    schedule=None, membership=None) -> None:
+    # telemetry is per-epoch scratch (DESIGN.md §14) and membership is
+    # host-reconstructible occupancy (DESIGN.md §16, persisted as a JSON
+    # sidecar below) — both stripped HERE, not at call sites: checkpoint
+    # pytrees must be identical whether either feature is on, and an
+    # invariant every caller has to remember is an invariant that
+    # eventually breaks.  restore_checkpoint strips its template
+    # symmetrically — which is also what lets a checkpoint written at one
+    # pool occupancy restore into a run at another: the arrays are the
+    # full static pool either way, and the sidecar says who the rows
+    # belonged to.
+    state = state.replace(telemetry=(), membership=())
     mgr = _manager(directory)
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
@@ -89,21 +111,29 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
         with open(tmp, "w") as f:
             json.dump(schedule_fingerprint(schedule), f)
         os.replace(tmp, path)
+    if membership is not None:
+        path = _membership_sidecar_path(directory, epoch)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(membership, f)
+        os.replace(tmp, path)
     # prune sidecars whose step orbax (max_to_keep) has garbage-collected:
-    # on directory reuse a stale schedule-<epoch>.json from a prior run could
-    # otherwise be verified against a later checkpoint at the same epoch
+    # on directory reuse a stale schedule-<epoch>.json (or the membership
+    # twin) from a prior run could otherwise be read against a later
+    # checkpoint at the same epoch
     root = os.path.abspath(directory)
     for fname in os.listdir(root):
-        if fname.startswith("schedule-") and fname.endswith(".json"):
-            try:
-                step = int(fname[len("schedule-"):-len(".json")])
-            except ValueError:
-                continue
-            if step not in kept:
+        for prefix in ("schedule-", "membership-"):
+            if fname.startswith(prefix) and fname.endswith(".json"):
                 try:
-                    os.remove(os.path.join(root, fname))
-                except OSError:
-                    pass
+                    step = int(fname[len(prefix):-len(".json")])
+                except ValueError:
+                    continue
+                if step not in kept:
+                    try:
+                        os.remove(os.path.join(root, fname))
+                    except OSError:
+                        pass
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -130,12 +160,14 @@ def restore_checkpoint(directory: str, template: TrainState,
     step = epoch if epoch is not None else mgr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {directory}")
-    # telemetry is per-epoch scratch and is NEVER persisted (the train loop
-    # strips it on save) — strip it from any template here too, so a caller
-    # holding a live state restores cleanly, and pass the caller's own
-    # accumulator back through unchanged
+    # telemetry is per-epoch scratch and membership is sidecar-persisted
+    # occupancy — NEITHER is in the checkpoint pytree (save strips both) —
+    # strip them from any template here too, so a caller holding a live
+    # state restores cleanly, and pass the caller's own slots back through
+    # unchanged
     caller_telemetry = template.telemetry
-    template = template.replace(telemetry=())
+    caller_membership = template.membership
+    template = template.replace(telemetry=(), membership=())
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
     try:
         state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
@@ -145,9 +177,11 @@ def restore_checkpoint(directory: str, template: TrainState,
         # that carries the extra slot (even an empty `()` one — the field
         # name is still a dict key).  Retry through progressively older
         # templates, newest plausible first:
-        #   1. minus `telemetry` (PR4–PR6: has mix_pending, pre-obs) — the
-        #      slot is per-epoch scratch that is never persisted anyway;
-        #   2. minus `telemetry` and `mix_pending` (pre-PR4 legacy): a
+        #   1. minus `membership` (PR7–PR8: has the telemetry slot, pre-
+        #      elastic) — occupancy is sidecar state, never in the pytree;
+        #   2. minus `membership` and `telemetry` (PR4–PR6: has
+        #      mix_pending, pre-obs);
+        #   3. minus all three plus `mix_pending` (pre-PR4 legacy): a
         #      checkpoint from before the overlapped pipeline truthfully
         #      carries no in-flight delta, and `_reconcile_mix_pending` in
         #      train/loop.py primes a zero delta if this run resumes with
@@ -157,7 +191,8 @@ def restore_checkpoint(directory: str, template: TrainState,
         fields = {f.name: getattr(abstract, f.name)
                   for f in dataclasses.fields(template)}
         state = None
-        for drop in (("telemetry",), ("telemetry", "mix_pending")):
+        for drop in (("membership",), ("membership", "telemetry"),
+                     ("membership", "telemetry", "mix_pending")):
             older = {k: v for k, v in fields.items() if k not in drop}
             try:
                 restored = mgr.restore(
@@ -174,7 +209,8 @@ def restore_checkpoint(directory: str, template: TrainState,
             mgr.close()
             raise e  # none of the known generations: the original error
             # names the real mismatch
-    state = state.replace(telemetry=caller_telemetry)
+    state = state.replace(telemetry=caller_telemetry,
+                          membership=caller_membership)
     mgr.close()
     if schedule is not None:
         cursor = int(np.asarray(state.step))

@@ -112,6 +112,25 @@ class TrainConfig:
     max_recoveries: int = 0
     recovery_lr_backoff: float = 0.5
 
+    # elastic membership (DESIGN.md §16): a declarative churn trace —
+    # an elastic.MembershipTrace, a parsed dict, or a path to its JSON
+    # (train_tpu.py --membership-trace).  Events (join/leave/rejoin of
+    # named workers) reconcile at epoch boundaries only; live workers map
+    # onto the static num_workers-slot pool, so the compiled step is
+    # reused verbatim across every change.  None disables all elastic
+    # machinery (the exact pre-elastic program compiles).
+    membership_trace: Optional[object] = None
+    # epochs the membership must stay unchanged before α/ρ are re-derived
+    # for the new live set (0 = eager re-plan at the change boundary; the
+    # alive mask always applies immediately — masking is correctness, α is
+    # optimization).  plan_tpu.py elasticity scores this trade-off offline.
+    membership_hysteresis: int = 0
+    # join/rejoin state bootstrap: "mean" initializes every (re)entering
+    # worker's rows from the continuing members' average; "restore" lets a
+    # rejoiner keep its own quarantined rows when its slot is untouched
+    # and still finite (momentum/carry/overlap-delta reset either way).
+    membership_bootstrap: str = "mean"
+
     # observability (DESIGN.md §14).  telemetry=True threads the
     # obs.Telemetry scalar accumulator through the compiled step (a handful
     # of fused adds, read once per epoch — no per-step host sync) and arms
@@ -229,3 +248,16 @@ class TrainConfig:
             raise ValueError(
                 "fault_plan needs a communicator: without gossip there are "
                 "no links to fail and no peers to heal a worker from")
+        if self.membership_hysteresis < 0:
+            raise ValueError(
+                f"membership_hysteresis must be >= 0, got "
+                f"{self.membership_hysteresis}")
+        if self.membership_bootstrap not in ("mean", "restore"):
+            raise ValueError(
+                f"membership_bootstrap must be 'mean' or 'restore', got "
+                f"{self.membership_bootstrap!r}")
+        if self.membership_trace is not None and self.communicator == "none":
+            raise ValueError(
+                "membership_trace needs a communicator: a joining worker "
+                "bootstraps from its peers' consensus, which requires a "
+                "mixing process to rejoin")

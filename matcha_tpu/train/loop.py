@@ -183,6 +183,23 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     # stream (what the cursor's meaning depends on) is identical either way.
     schedule0 = schedule
 
+    # elastic membership (DESIGN.md §16): the trace replays at epoch
+    # boundaries through a deterministic host controller; the device sees
+    # only the [N_pool] alive mask + α scale riding TrainState.membership.
+    # Membership re-plans scale the *executed* α through the traced scalar,
+    # so — unlike the recovery path's α re-derivation — nothing recompiles
+    # and `schedule` itself is never rebound by a membership change.
+    elastic_ctl = None
+    if config.membership_trace is not None:
+        from ..elastic import ElasticController, load_membership_trace
+
+        elastic_ctl = ElasticController(
+            load_membership_trace(config.membership_trace),
+            config.num_workers,
+            hysteresis=config.membership_hysteresis,
+            bootstrap=config.membership_bootstrap,
+        )
+
     mesh = None
     if config.devices is None or config.devices > 1:
         try:
@@ -252,8 +269,51 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         tel = Telemetry.zeros()
         return shard_workers(tel, mesh) if mesh is not None else tel
 
+    def _fresh_membership():
+        """Device image of the controller's (alive mask, α scale), rebuilt
+        host-fresh every epoch with the same placement discipline as
+        ``_fresh_telemetry``: the epoch program's input signature must be
+        identical whether or not this boundary changed membership, or the
+        change itself would recompile the step — the exact failure mode
+        elastic membership exists to avoid."""
+        from ..elastic.runtime import membership_arrays
+
+        m = membership_arrays(elastic_ctl.alive_mask(),
+                              elastic_ctl.alpha_scale)
+        return shard_workers(m, mesh) if mesh is not None else m
+
+    bootstrap_fn = None
+    member_alive_np = None
+    if elastic_ctl is not None:
+        from ..elastic.runtime import make_bootstrap_fn
+
+        bootstrap_fn = make_bootstrap_fn(flattener, config.num_workers)
+        member_alive_np = elastic_ctl.alive_mask() > 0
+
+    def _bootstrap_rows(state, joined, restored):
+        """Jitted boundary surgery for (re)entering slots: donors are the
+        continuing members — alive now, not themselves (re)entering."""
+        alive = elastic_ctl.alive_mask()
+        # graftlint: disable=GL001 — mask∘mask algebra on host 0/1 arrays
+        donors = alive * (1.0 - joined) * (1.0 - restored)
+        return bootstrap_fn(state, jnp.asarray(joined),
+                            jnp.asarray(restored), jnp.asarray(donors))
+
+    def _membership_sidecar():
+        """What checkpoints record next to the state: who owns which pool
+        slot (the row-mapping key for cross-occupancy restore) and the α
+        re-plan in effect."""
+        if elastic_ctl is None:
+            return None
+        return {"view": elastic_ctl.view.to_json(),
+                "alpha": elastic_ctl.alpha,
+                "rho": elastic_ctl.rho,
+                "alpha_scale": elastic_ctl.alpha_scale}
+
     if tel_spec is not None:
         state = state.replace(telemetry=_fresh_telemetry())
+    if elastic_ctl is not None:
+        state = state.replace(membership=_fresh_membership())
     if mesh is not None:
         state = shard_workers(state, mesh)
 
@@ -266,6 +326,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             dropout=False, lr_schedule=lr_schedule,
             grad_chunk=config.grad_chunk, faults=faults,
             overlap=config.overlap, telemetry=tel_spec,
+            elastic=elastic_ctl is not None,
         )
 
     step_fn = None  # populated by _build_programs() below
@@ -349,6 +410,23 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         start_epoch = last_epoch + 1
         state = _reconcile_mix_pending(state, config.overlap, communicator,
                                        flattener, config.num_workers)
+        if elastic_ctl is not None:
+            # reconstruct the controller state this boundary had (the trace
+            # replays deterministically — byte-identical resume is pinned by
+            # test), then map the restored rows onto the current occupancy:
+            # a slot whose saved content belongs to a different worker (or
+            # to nobody) bootstraps from the continuing members, which is
+            # how one checkpoint restores onto a larger or smaller live set
+            from .checkpoint import load_membership_sidecar
+
+            elastic_ctl.replay_to(start_epoch, schedule)
+            member_alive_np = elastic_ctl.alive_mask() > 0
+            side = load_membership_sidecar(resume_dir, last_epoch)
+            joined, restored = elastic_ctl.reconcile_restored(
+                (side or {}).get("view"))
+            if joined.any() or restored.any():
+                state = _bootstrap_rows(state, joined, restored)
+            state = state.replace(membership=_fresh_membership())
         if tel_spec is not None:
             state = state.replace(telemetry=_fresh_telemetry())
         if mesh is not None:  # reconcile may have created fresh zero rows
@@ -385,11 +463,23 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     # decen communicator is modeled by the spectral bound; CHOCO's γ-damped
     # consensus and the centralized AllReduce are out of its scope.
     def _compose_predicted():
+        # worker availability composes multiplicatively: the fault plan's
+        # expectation × the membership occupancy (a vacant slot is simply
+        # dead to the mixing, whatever the fault plan thought of it)
+        fault_alive = (np.asarray(faults.expected_alive(), np.float64)
+                       if faults is not None else None)
+        member_alive = (np.asarray(elastic_ctl.alive_mask(), np.float64)
+                        if elastic_ctl is not None else None)
+        if fault_alive is None:
+            worker_alive = member_alive
+        elif member_alive is None:
+            worker_alive = fault_alive
+        else:
+            worker_alive = fault_alive * member_alive
         pred = compose_predicted_rho(
             schedule.laplacians(), schedule.probs, plan_alpha,
             overlap=config.overlap, wire_dtype=config.wire_dtype,
-            worker_alive=(np.asarray(faults.expected_alive(), np.float64)
-                          if faults is not None else None),
+            worker_alive=worker_alive,
             link_up=(np.asarray(faults.expected_link_up(), np.float64)
                      if faults is not None else None),
         )
@@ -402,6 +492,10 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
     predicted = None
     drift_monitor = None
+    if elastic_ctl is not None and elastic_ctl.alpha is not None:
+        # a resumed run replayed membership re-plans above: the plan in
+        # force is the re-folded α, not the schedule-built one
+        plan_alpha = float(elastic_ctl.alpha)
     if config.telemetry and config.communicator == "decen":
         predicted = _compose_predicted()
         drift_monitor = DriftMonitor(
@@ -472,6 +566,44 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
     epoch = start_epoch
     while epoch < config.epochs:
+        if elastic_ctl is not None:
+            # membership reconciliation — at this host boundary and nowhere
+            # else (DESIGN.md §16).  advance() is idempotent per epoch, so
+            # a rollback retry re-entering this loop top does not re-apply
+            # the transition (the bootstrap is part of the retry snapshot).
+            trans = elastic_ctl.advance(epoch, schedule)
+            if trans is not None:
+                member_alive_np = trans.new_alive > 0
+                if trans.joined.any() or trans.restored.any():
+                    with annotate("matcha/membership_bootstrap"):
+                        state = _bootstrap_rows(state, trans.joined,
+                                                trans.restored)
+                new_pred = None
+                if trans.replanned:
+                    # the re-folded α IS the plan from here on — the drift
+                    # monitor and the journal both re-base, exactly like
+                    # the recovery path's α re-derivation (§8)
+                    plan_alpha = float(trans.alpha)
+                    if drift_monitor is not None:
+                        predicted = new_pred = _compose_predicted()
+                        drift_monitor = DriftMonitor(
+                            predicted["rho"], int(bpe),
+                            tolerance=config.drift_tolerance,
+                            patience=config.drift_patience)
+                recorder.log_event(
+                    "membership", epoch=epoch,
+                    old_alive=[float(v) for v in trans.old_alive],
+                    new_alive=[float(v) for v in trans.new_alive],
+                    trigger=list(trans.trigger),
+                    alpha=float(trans.alpha),
+                    rho=None if trans.rho is None else float(trans.rho),
+                    alpha_scale=float(trans.alpha_scale),
+                    replanned=bool(trans.replanned),
+                    predicted=new_pred or {})
+            # re-primed host-fresh EVERY epoch (transition or not), so the
+            # compiled epoch program sees one input placement signature —
+            # the same discipline as _fresh_telemetry, for the same reason
+            state = state.replace(membership=_fresh_membership())
         if recoveries_used < config.max_recoveries:
             # budget exhausted ⇒ stop paying the copy (it could never be
             # used); the stale snapshot must not linger in HBM either
@@ -529,6 +661,11 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 relevant = faults.dead_alive[cursor] > 0
             else:
                 relevant = np.ones_like(finite_rows)
+            if member_alive_np is not None:
+                # vacant pool slots are frozen, quarantined rows — their
+                # content is nobody's training state until a (re)join
+                # bootstraps it, so it cannot convict the run
+                relevant = relevant & member_alive_np
             params_bad = bool(np.any(~finite_rows & relevant))
             if loss_bad or params_bad:
                 what = ("training loss " + str(epoch_metrics["loss"])) if loss_bad \
@@ -541,7 +678,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                         path = f"{config.savePath}/{config.name}_emergency"
                         with annotate("matcha/checkpoint"):
                             save_checkpoint(path, snapshot, epoch - 1,
-                                            schedule=schedule0)
+                                            schedule=schedule0,
+                                            membership=_membership_sidecar())
                         emergency_written = True
                         recorder.log_fault("emergency_checkpoint",
                                            epoch=epoch, path=path)
@@ -561,20 +699,46 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                         # solver at run time instead of only in offline
                         # studies
                         alpha_rederived = True
+                        member_mask = (elastic_ctl.alive_mask()
+                                       if elastic_ctl is not None else None)
                         if faults is not None:
                             from ..resilience import resolve_degraded_alpha
 
+                            # membership occupancy composes into the solve
+                            # (a vacant slot is dead whatever the fault
+                            # plan expected) — same rule as the drift
+                            # monitor's _compose_predicted
                             new_alpha, new_rho, _ = resolve_degraded_alpha(
-                                schedule, faults)
+                                schedule, faults, worker_alive=member_mask)
+                        elif member_mask is not None:
+                            new_alpha, new_rho, _ = schedule.refold_for(
+                                member_mask)
                         else:
                             from ..schedule import solve_mixing_weight
 
                             new_alpha, new_rho = solve_mixing_weight(
                                 schedule.laplacians(), schedule.probs)
-                        if abs(new_alpha - schedule.alpha) > 1e-9:
-                            old_alpha = float(schedule.alpha)
+                        # the α actually executing is base × membership
+                        # scale — that is what the re-derivation replaces
+                        executed_alpha = float(schedule.alpha) * (
+                            elastic_ctl.alpha_scale
+                            if elastic_ctl is not None else 1.0)
+                        if abs(new_alpha - executed_alpha) > 1e-9:
+                            old_alpha = executed_alpha
                             schedule = dataclasses.replace(
                                 schedule, alpha=float(new_alpha))
+                            if elastic_ctl is not None:
+                                # the composed solve subsumes the
+                                # membership re-fold: new_alpha IS the
+                                # executed α, so the controller re-bases
+                                # to scale 1 against the rebound schedule
+                                # (later membership folds re-derive
+                                # against the new base); the loop-top
+                                # _fresh_membership() re-primes the
+                                # device copy on the retry
+                                elastic_ctl.alpha = float(new_alpha)
+                                elastic_ctl.rho = float(new_rho)
+                                elastic_ctl.alpha_scale = 1.0
                             # the re-derived α IS the plan from here on:
                             # the drift monitor must predict with it, or
                             # every post-recovery epoch would be scored
@@ -646,15 +810,20 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 evaluate, state, dataset.x_test, dataset.y_test,
                 batch=eval_batch, ledger=cost_ledger
             )
-            if faults is not None:
+            if faults is not None or member_alive_np is not None:
                 # same quarantine exemption as the train-side metrics: a
-                # plan-dead worker's local state may legitimately be garbage
-                # (it will be healed at revival) — its eval entries become
-                # explicit NaN gaps instead of silently poisoning the tacc
-                # series and the test_*_mean history the sweep/verify
-                # consumers read
-                cur = max(min(int(state.step) - 1, faults.iterations - 1), 0)
-                eval_alive = faults.dead_alive[cur] > 0
+                # plan-dead worker's (or vacant pool slot's) local state may
+                # legitimately be garbage — its eval entries become explicit
+                # NaN gaps instead of silently poisoning the tacc series and
+                # the test_*_mean history the sweep/verify consumers read
+                if faults is not None:
+                    cur = max(min(int(state.step) - 1,
+                                  faults.iterations - 1), 0)
+                    eval_alive = faults.dead_alive[cur] > 0
+                    if member_alive_np is not None:
+                        eval_alive = eval_alive & member_alive_np
+                else:
+                    eval_alive = member_alive_np
                 test_loss = np.where(eval_alive, test_loss, np.nan)
                 test_acc = np.where(eval_alive, test_acc, np.nan)
 
@@ -709,7 +878,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         if config.checkpoint_every and (epoch + 1) % config.checkpoint_every == 0:
             path = f"{config.savePath}/{config.name}_ckpt"
             with annotate("matcha/checkpoint"):
-                save_checkpoint(path, state, epoch, schedule=schedule0)
+                save_checkpoint(path, state, epoch, schedule=schedule0,
+                                membership=_membership_sidecar())
             recorder.log_event("checkpoint", epoch=epoch, path=path)
         epoch += 1
 

@@ -58,6 +58,16 @@ class TrainState(struct.PyTreeNode):
     # checkpoint pytrees are identical with telemetry on or off (and
     # pre-obs checkpoints restore unchanged).
     telemetry: Any = ()
+    # elastic membership (DESIGN.md §16): an ``elastic.Membership`` pytree
+    # (``alive: f32[N_pool]`` + ``alpha_scale`` scalar) when a membership
+    # trace drives the run, the empty tuple otherwise.  A *step input* on
+    # purpose: membership changes are value updates at epoch boundaries,
+    # never shape changes, so the compiled epoch program is reused verbatim
+    # across join/leave/rejoin (the no-retrace contract the §14 watch
+    # enforces).  Like telemetry it is reconstructible host state
+    # (checkpoints carry a membership sidecar instead) and is stripped to
+    # ``()`` around save/restore — checkpoint pytrees never change.
+    membership: Any = ()
 
 
 def make_optimizer(
@@ -128,6 +138,7 @@ def make_train_step(
     faults=None,
     overlap: str = "off",
     telemetry=None,
+    elastic: bool = False,
 ):
     """Build ``step(state, xb, yb[, rng]) -> (state, metrics)``.
 
@@ -181,6 +192,20 @@ def make_train_step(
     whatsoever happens here — the loop reads the accumulator once per
     epoch (DESIGN.md §14).  ``None`` (or an empty ``state.telemetry``
     slot) compiles the exact pre-observability program.
+
+    ``elastic``: when True *and* ``state.membership`` is a real
+    ``elastic.Membership`` pytree, the step consumes the pool-occupancy
+    mask and the α re-plan as **runtime inputs** (DESIGN.md §16): the
+    alive mask multiplies into the gossip survivor mask (composing with
+    any fault plan), ``alpha_scale`` multiplies the flag row so the
+    epoch-boundary re-derived mixing weight executes without recompiling
+    anything, vacant slots are frozen at their leave-time values (their
+    computed updates are discarded by a ``where`` — a rejoin must find the
+    state the worker left, not un-mixed solo-SGD drift), and fleet metrics
+    / telemetry average over live members only.  Everything is value-level:
+    join, leave, and rejoin never change a shape, which is the whole
+    no-retrace contract the §14 watch enforces.  ``False`` (or an empty
+    slot) compiles the exact pre-elastic program.
     """
     flags_arr = jnp.asarray(np.asarray(flags), jnp.float32)  # [T, M]
     n_workers = flattener.num_workers
@@ -254,8 +279,18 @@ def make_train_step(
         t = jnp.minimum(state.step, flags_arr.shape[0] - 1)
         comm_carry = state.comm_carry
         mix_pending = state.mix_pending
+        # elastic membership (DESIGN.md §16): the pool mask and the α
+        # re-plan arrive as runtime values riding the state — the same
+        # compiled program serves every live set.  Every backend's per-step
+        # edge weight is α·flag_j, so scaling the flag row by α′/α executes
+        # the re-derived α′ exactly, on dense/gather/skip/folded alike.
+        member = None
+        comm_flags_t = flags_arr[t]
+        if elastic and not isinstance(state.membership, tuple):
+            member = state.membership.alive
+            comm_flags_t = flags_arr[t] * state.membership.alpha_scale
         alive = None
-        if faults is not None:
+        if faults is not None or member is not None:
             from ..resilience.runtime import (
                 begin_mix_quarantined,
                 gossip_quarantined,
@@ -266,9 +301,22 @@ def make_train_step(
             )
 
             with device_span("matcha/heal"):
-                flat = inject_nan_rows(flat, inject_arr[t])
+                if faults is not None:
+                    flat = inject_nan_rows(flat, inject_arr[t])
+                    alive_t, revive_t = alive_arr[t], revive_arr[t]
+                    if member is not None:
+                        # compose: a vacant slot is dead regardless of the
+                        # fault plan, and a planned revival of a vacant
+                        # slot stays vacant (membership owns re-entry)
+                        # graftlint: disable=GL001 — mask∘mask algebra on
+                        # 0/1 plan arrays and the membership mask
+                        alive_t = alive_t * member
+                        revive_t = revive_t * member
+                else:
+                    alive_t = member
+                    revive_t = jnp.zeros_like(member)
                 flat, alive, healed, row_finite = heal_and_mask(
-                    flat, alive_arr[t], revive_arr[t])
+                    flat, alive_t, revive_t)
                 keep = 1.0 - healed
                 opt_state = mask_worker_rows(opt_state, keep, n)
                 comm_carry = mask_worker_rows(comm_carry, keep, n)
@@ -290,21 +338,41 @@ def make_train_step(
             flat = communicator.apply_mix(flat, mix_pending)
             if alive is None:
                 mix_pending, carry = communicator.begin_mix(
-                    flat, comm_carry, flags_arr[t])
+                    flat, comm_carry, comm_flags_t)
             else:
                 mix_pending, carry = begin_mix_quarantined(
-                    communicator.begin_mix, flat, comm_carry, flags_arr[t],
+                    communicator.begin_mix, flat, comm_carry, comm_flags_t,
                     alive, gate=row_finite)
         elif alive is None:
             with device_span("comm/step"):
                 flat, carry = communicator.step(flat, comm_carry,
-                                                flags_arr[t])
+                                                comm_flags_t)
         else:
             with device_span("comm/step"):
                 flat, carry = gossip_quarantined(
-                    communicator.step, flat, comm_carry, flags_arr[t], alive,
+                    communicator.step, flat, comm_carry, comm_flags_t, alive,
                     gate=row_finite)
         params = flattener.unflatten(flat)
+        if member is not None:
+            # vacant slots are frozen at their leave-time values: the SPMD
+            # program computed their updates (static shapes — it cannot
+            # not), and this is where those updates are discarded.  A
+            # rejoin must find the state the worker actually left; masked
+            # gossip already self-loops these rows, so the freeze touches
+            # only what SGD/BN wrote.
+            from ..elastic.runtime import freeze_worker_rows
+
+            params = freeze_worker_rows(params, state.params, member, n)
+            new_stats = freeze_worker_rows(new_stats, state.batch_stats,
+                                           member, n)
+            opt_state = freeze_worker_rows(opt_state, state.opt_state,
+                                           member, n)
+            carry = freeze_worker_rows(carry, state.comm_carry, member, n)
+            if overlap_on:
+                # a vacant slot neither issues nor consumes mixing deltas —
+                # zeroing every step also drops a leaver's stale in-flight
+                # delta the moment its slot vacates
+                mix_pending = mask_worker_rows(mix_pending, member, n)
 
         def _fleet_mean(v):
             """Mean over workers — quarantined rows excluded under faults.
@@ -340,7 +408,7 @@ def make_train_step(
             "lr": lr_schedule(state.step) if lr_schedule else jnp.asarray(0.0),
             "active_matchings": jnp.sum(flags_arr[t]),
         }
-        if faults is not None:
+        if faults is not None or member is not None:
             metrics["healed"] = jnp.sum(healed)
             metrics["alive_workers"] = jnp.sum(alive)
         new_tel = state.telemetry
@@ -353,7 +421,8 @@ def make_train_step(
                 state.telemetry, telemetry,
                 disagreement=metrics["disagreement"],
                 flags_t=flags_arr[t],
-                alive_count=(metrics["alive_workers"] if faults is not None
+                alive_count=(metrics["alive_workers"]
+                             if "alive_workers" in metrics
                              else jnp.asarray(np.float32(n))),
                 healed=heal_count,
                 # overlapped heal drops the healed rows' pending deltas

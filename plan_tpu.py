@@ -22,6 +22,19 @@ Subcommands
             ``--calibrate benchmarks/budget_sweep.json`` fits the cost model
             from a committed measurement table instead of unit costs.
 
+``elasticity``  score elastic-membership policies (re-plan eagerly vs.
+            hysteresis-K; bootstrap-from-mean vs. restore-own-rows) against
+            a declared churn trace, with the MC flag-stream simulator::
+
+                python plan_tpu.py elasticity --graphid 5 --budget 0.5 \
+                    --trace churn.json --out elasticity_plan.json
+                python train_tpu.py --membership-trace churn.json \
+                    --membership-hysteresis K --membership-bootstrap mean|restore
+
+            The artifact is plan-format (``matcha_tpu.plan/1``) — planlint
+            verifies its solver claims like any committed plan — and the
+            chosen candidate names the winning policy.
+
 ``verify``  compare a plan's predicted disagreement decay against the
             Recorder CSVs of a real run::
 
@@ -255,6 +268,63 @@ def cmd_sweep(args) -> int:
     return 0
 
 
+def cmd_elasticity(args) -> int:
+    from matcha_tpu.elastic import load_membership_trace
+    from matcha_tpu.elastic.policy import (
+        elasticity_artifact,
+        score_elasticity_policies,
+    )
+    from matcha_tpu.plan.autotune import resolve_topology
+
+    try:
+        hysteresis = sorted({int(h) for h in args.hysteresis.split(",")})
+    except ValueError:
+        raise SystemExit(f"--hysteresis must be a comma list of ints, got "
+                         f"{args.hysteresis!r}")
+    if any(h < 0 for h in hysteresis):
+        raise SystemExit("--hysteresis values must be >= 0")
+    trace = load_membership_trace(args.trace)
+    (spec,) = _topology_specs(args)
+    decomposed, size, norm = resolve_topology(spec, args.seed)
+    report = score_elasticity_policies(
+        decomposed, size, args.budget, trace, seed=args.seed,
+        epochs=args.epochs, steps_per_epoch=args.steps_per_epoch,
+        trials=max(args.mc_trials, 1), hysteresis=hysteresis,
+        solver_iters=args.solver_iters)
+    out_path = args.out
+    if out_path:
+        artifact = elasticity_artifact(report, norm, target=args.target)
+        save_plan(artifact, out_path)
+        # same self-check as sweep: never emit an artifact the committed-
+        # plan verifier would reject
+        from matcha_tpu.analysis import lint_plan_file, render_plan_text
+
+        plan_violations, _ = lint_plan_file(out_path)
+        if plan_violations:
+            print(render_plan_text(plan_violations, [out_path]),
+                  file=sys.stderr)
+            print(f"# wrote {out_path}, but it FAILS planlint — do not "
+                  f"commit", file=sys.stderr)
+            return 1
+        print(f"# wrote {out_path}", file=sys.stderr)
+    best = report["policies"][0]
+    print(json.dumps({
+        **norm, "budget": args.budget,
+        "pool_alpha": report["pool"]["alpha"],
+        "pool_rho": report["pool"]["rho"],
+        "trace": trace.name,
+        "chosen_policy": {"replan": best["replan"],
+                          "hysteresis": best["hysteresis"],
+                          "bootstrap": best["bootstrap"]},
+        "ranking": [
+            {"replan": p["replan"], "bootstrap": p["bootstrap"],
+             "score": p["score"], "final_error": p["final_error"]}
+            for p in report["policies"]
+        ],
+    }, indent=1))
+    return 0
+
+
 def cmd_verify(args) -> int:
     artifact = load_plan(args.plan)
     report = verify_plan_run(artifact, args.run_dir, args.steps_per_epoch,
@@ -348,6 +418,25 @@ def main(argv=None) -> int:
                          "runs must come from the same topology and --chips "
                          "being planned, or the fit is meaningless")
     sp.set_defaults(fn=cmd_sweep)
+
+    sp = sub.add_parser("elasticity",
+                        help="score join/leave/rejoin policies vs a churn "
+                             "trace; write a planlint-verifiable artifact")
+    add_common(sp, mc_default=4)
+    sp.add_argument("--budget", type=float, default=0.5)
+    sp.add_argument("--trace", required=True,
+                    help="membership trace JSON (the same file "
+                         "train_tpu.py --membership-trace consumes)")
+    sp.add_argument("--epochs", type=int, default=None,
+                    help="simulated epochs (default: trace horizon + 3)")
+    sp.add_argument("--steps-per-epoch", type=int, default=16,
+                    dest="steps_per_epoch")
+    sp.add_argument("--hysteresis", default="0,2",
+                    help="comma list of re-plan hysteresis values to score "
+                         "(0 = eager)")
+    sp.add_argument("--out", default=None,
+                    help="write the plan-format elasticity artifact here")
+    sp.set_defaults(fn=cmd_elasticity)
 
     sp = sub.add_parser("verify", help="plan vs a real run's Recorder CSVs")
     sp.add_argument("--plan", required=True)

@@ -1,0 +1,542 @@
+"""Elastic membership (DESIGN.md §16): online join/leave/rejoin.
+
+The churn e2e is the ISSUE-9 acceptance run — a ring-8 CPU train where a
+worker leaves, a fresh one joins, and the original rejoins — asserting:
+
+* **zero retraces**: the compiled epoch program's jit cache never grows
+  after epoch 1 (the journal holds no ``retrace`` events), and the step
+  itself holds at one trace under ``check_single_trace`` while membership
+  values change mid-stream;
+* **doubly-stochastic realized mixing over every intermediate live set**
+  (to 1e-6, via planlint's linearity argument: singleton + all-on draws);
+* a ``membership`` journal event with re-derived α/ρ at each transition;
+* **byte-identical resume** through membership-change checkpoints at both
+  the shrunk and the grown live set, and restore of a mid-churn checkpoint
+  onto a **larger and a smaller** live set that then trains on;
+* final live-set disagreement within a small factor of the fault-free run.
+
+All runs share module-scoped fixtures — the suite pays for each training
+program once.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from matcha_tpu.elastic import (
+    ElasticController,
+    MembershipEvent,
+    MembershipTrace,
+    MembershipView,
+    load_membership_trace,
+)
+from matcha_tpu.train import TrainConfig, train
+
+pytestmark = pytest.mark.elastic
+
+# ring-8 pool, 7 initial members (slot 7 is spare capacity — a full pool
+# could only place the epoch-2 join by recycling w3's slot, forfeiting the
+# epoch-3 rejoin's restore-own rows)
+TRACE = {
+    "initial": ["w0", "w1", "w2", "w3", "w4", "w5", "w6"],
+    "events": [
+        {"kind": "leave", "epoch": 1, "worker": "w3"},
+        {"kind": "join", "epoch": 2, "worker": "fresh"},
+        {"kind": "rejoin", "epoch": 3, "worker": "w3"},
+    ],
+}
+EPOCHS = 5
+
+BASE = dict(
+    name="elastic", model="mlp", dataset="synthetic",
+    dataset_kwargs={"num_train": 128, "num_test": 32},
+    num_workers=8, graphid=5, batch_size=8, epochs=EPOCHS, lr=0.05,
+    warmup=False, matcha=True, budget=0.5, seed=3, eval_every=0,
+    measure_comm_split=False,
+)
+
+
+def _cfg(tmp, **kw):
+    return TrainConfig(**{**BASE, "savePath": str(tmp), **kw})
+
+
+@pytest.fixture(scope="module")
+def churn_run(tmp_path_factory):
+    """The full uninterrupted churn run, journaled."""
+    tmp = tmp_path_factory.mktemp("churn_full")
+    cfg = _cfg(tmp, membership_trace=dict(TRACE), save=True)
+    return train(cfg), tmp, cfg
+
+
+@pytest.fixture(scope="module")
+def control_run(tmp_path_factory):
+    """Fault-free 8-live control for the disagreement comparison."""
+    tmp = tmp_path_factory.mktemp("churn_ctl")
+    return train(_cfg(tmp))
+
+
+@pytest.fixture(scope="module")
+def shrink_ckpt(tmp_path_factory):
+    """Checkpoint written right after the leave (6-live boundary)."""
+    tmp = tmp_path_factory.mktemp("churn_shrink")
+    cfg = _cfg(tmp, membership_trace=dict(TRACE), epochs=2,
+               checkpoint_every=2)
+    train(cfg)
+    return f"{cfg.savePath}/{cfg.name}_ckpt", tmp
+
+
+@pytest.fixture(scope="module")
+def grow_ckpt(tmp_path_factory):
+    """Checkpoint written right after the fresh join (7-live boundary)."""
+    tmp = tmp_path_factory.mktemp("churn_grow")
+    cfg = _cfg(tmp, membership_trace=dict(TRACE), epochs=3,
+               checkpoint_every=3)
+    train(cfg)
+    return f"{cfg.savePath}/{cfg.name}_ckpt", tmp
+
+
+def _journal(run_dir, cfg):
+    path = run_dir / f"{cfg.name}_{cfg.model}" / "events.jsonl"
+    return [json.loads(line) for line in
+            path.read_text().strip().splitlines()]
+
+
+# ----------------------------------------------------------- view mechanics
+
+def test_view_slot_machine():
+    view = MembershipView.start(4, ["a", "b", "c"])
+    assert view.alive_mask().tolist() == [1, 1, 1, 0]
+    j, r = view.apply([MembershipEvent("leave", 0, "b")])
+    assert view.occupants == ["a", None, "c", None]
+    assert not j.any() and not r.any()
+    # fresh join prefers the never-owned slot 3 over b's vacated slot 1
+    j, r = view.apply([MembershipEvent("join", 1, "d")])
+    assert view.occupants == ["a", None, "c", "d"]
+    assert j.tolist() == [0, 0, 0, 1]
+    # rejoin lands back in its own slot, flagged restorable
+    j, r = view.apply([MembershipEvent("rejoin", 2, "b")])
+    assert view.occupants == ["a", "b", "c", "d"]
+    assert r.tolist() == [0, 1, 0, 0] and not j.any()
+
+
+def test_view_rejoin_recycled_slot_bootstraps():
+    view = MembershipView.start(3, ["a", "b", "c"])
+    view.apply([MembershipEvent("leave", 0, "b")])
+    view.apply([MembershipEvent("join", 1, "d")])  # recycles b's slot
+    j, r = view.apply([MembershipEvent("leave", 2, "a"),
+                       MembershipEvent("rejoin", 2, "b")])
+    # b's history is gone with its slot: rejoin degrades to a fresh join
+    assert j.sum() == 1 and not r.any()
+
+
+def test_view_errors():
+    view = MembershipView.start(3, ["a", "b", "c"])
+    with pytest.raises(ValueError, match="not a member"):
+        view.apply([MembershipEvent("leave", 0, "nope")])
+    with pytest.raises(ValueError, match="already a member"):
+        view.apply([MembershipEvent("join", 0, "a")])
+    view.apply([MembershipEvent("leave", 1, "c")])
+    with pytest.raises(ValueError, match="below 2 live"):
+        view.apply([MembershipEvent("leave", 2, "b")])
+    with pytest.raises(ValueError, match=">= 2 live"):
+        MembershipView.start(4, ["solo"])
+
+
+def test_trace_roundtrip_and_loader(tmp_path):
+    trace = load_membership_trace(TRACE)
+    assert trace.horizon() == 3
+    assert trace.initial == tuple(TRACE["initial"])
+    again = MembershipTrace.from_json(trace.to_json())
+    assert again == trace
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(TRACE))
+    assert load_membership_trace(str(p)) == trace
+    with pytest.raises(ValueError, match="unknown membership kind"):
+        MembershipEvent("explode", 0, "w0")
+
+
+class _StubSchedule:
+    alpha = 0.5
+
+    def refold_for(self, alive):
+        # α shrinks with the live set — enough structure to observe
+        return 0.1 * float(np.sum(alive)), 0.9, None
+
+
+def test_controller_hysteresis_defers_the_fold():
+    trace = load_membership_trace(
+        {"events": [{"kind": "leave", "epoch": 1, "worker": "w0"}]})
+    ctl = ElasticController(trace, 4, hysteresis=2)
+    sched = _StubSchedule()
+    assert ctl.advance(0, sched) is None  # full start: nothing pending
+    t1 = ctl.advance(1, sched)
+    assert t1 is not None and not t1.replanned  # masked now, fold deferred
+    assert t1.new_alive.sum() == 3 and ctl.alpha_scale == 1.0
+    assert ctl.advance(2, sched) is None  # still deferring, nothing new
+    t3 = ctl.advance(3, sched)  # stable for 2 epochs: fold lands
+    assert t3 is not None and t3.replanned and t3.trigger == ()
+    assert t3.alpha == pytest.approx(0.3)
+    assert ctl.alpha_scale == pytest.approx(0.3 / 0.5)
+    assert ctl.advance(3, sched) is None  # idempotent per epoch (rollback)
+
+
+def test_controller_replay_matches_live_advance():
+    trace = load_membership_trace(TRACE)
+    live = ElasticController(trace, 8)
+    sched = _StubSchedule()
+    for e in range(4):
+        live.advance(e, sched)
+    replayed = ElasticController(trace, 8)
+    replayed.replay_to(4, sched)
+    assert replayed.view.to_json() == live.view.to_json()
+    assert replayed.alpha_scale == live.alpha_scale
+    assert replayed.alpha == live.alpha
+
+
+def test_reconcile_restored_maps_occupancy():
+    trace = load_membership_trace(TRACE)
+    ctl = ElasticController(trace, 8, bootstrap="restore")
+    ctl.replay_to(4, _StubSchedule())  # live: w0-w6 minus nothing + fresh
+    # checkpoint taken before any churn: fully-default view
+    saved = MembershipView.full(8).to_json()
+    joined, restored = ctl.reconcile_restored(saved)
+    # slot 7 now holds "fresh" but the checkpoint's slot 7 belonged to w7
+    assert joined[7] == 1.0
+    # slot 3: w3 rejoined and the checkpoint's slot 3 is w3's own row
+    assert joined[3] == 0.0 and restored[3] == 0.0
+    with pytest.raises(ValueError, match="pool_size"):
+        ctl.reconcile_restored({"pool_size": 4, "occupants": [None] * 4,
+                                "owners": [None] * 4})
+
+
+def test_reconcile_restored_refuses_fleet_wide_bootstrap():
+    """A sidecar-less (pre-elastic, w0..wN-1) checkpoint resumed under a
+    trace with foreign worker ids shares zero live workers: every slot
+    would bootstrap from an empty donor set — the surgery's quorum guard
+    would refuse the param heal while momentum/carry still reset, a
+    silent fleet-wide wipe.  The reconciler must refuse loudly instead."""
+    foreign = load_membership_trace(
+        {"initial": ["alice", "bob", "carol", "dave"], "events": []})
+    ctl = ElasticController(foreign, 4)
+    with pytest.raises(ValueError, match="no live workers"):
+        ctl.reconcile_restored(None)  # pre-elastic default: w0..w3
+
+
+def test_deferred_first_transition_journals_rho_none_not_nan():
+    """Hysteresis deferring the very first fold has no ρ to report:
+    the transition must carry None (json.dumps renders NaN as a non-RFC
+    token that strict parsers reject), and the journal line must be
+    loadable by a strict reader."""
+    trace = load_membership_trace(
+        {"events": [{"kind": "leave", "epoch": 1, "worker": "w0"}]})
+    ctl = ElasticController(trace, 4, hysteresis=3)
+    t1 = ctl.advance(1, _StubSchedule())
+    assert not t1.replanned
+    assert t1.rho is None
+    line = json.dumps({"rho": t1.rho, "alpha": t1.alpha}, allow_nan=False)
+    assert json.loads(line)["rho"] is None
+
+
+def test_scorer_replay_gates_on_events_not_mask_diff():
+    """A full-pool leave+join at one epoch recycles a slot: the alive
+    mask never changes, but the entrant still bootstraps and hysteresis
+    still restarts — the offline replay must flag the boundary eventful
+    exactly as the runtime controller would (it gates on declared
+    events, not occupancy diffs)."""
+    from matcha_tpu.elastic.policy import _replay_occupancy
+
+    trace = load_membership_trace(
+        {"events": [{"kind": "leave", "epoch": 1, "worker": "w2"},
+                    {"kind": "join", "epoch": 1, "worker": "nu"}]})
+    alive, joined, restored, eventful = _replay_occupancy(trace, 4, 3)
+    assert np.array_equal(alive[0], alive[1])  # mask-diff sees nothing
+    assert eventful.tolist() == [False, True, False]
+    assert joined[1].sum() == 1  # the recycled entrant still bootstraps
+
+
+def test_recovery_alpha_composes_membership_occupancy():
+    """The rollback path's α re-derivation must see vacant pool slots —
+    solving over the full pool while two slots are vacant would execute
+    an α solved for a fleet that is not running (review finding)."""
+    from matcha_tpu.resilience import FaultPlan, resolve_degraded_alpha
+    from matcha_tpu.schedule import matcha_schedule
+    from matcha_tpu.topology import select_graph
+
+    sched = matcha_schedule(select_graph(5), 8, iterations=8, budget=0.5,
+                            seed=0)
+    faults = FaultPlan(events=()).compile(
+        iterations=8, num_workers=8,
+        num_matchings=len(sched.probs))
+    member = np.asarray([1, 1, 1, 0, 1, 1, 1, 0], np.float64)
+    a_full, r_full, _ = resolve_degraded_alpha(sched, faults)
+    a_mem, r_mem, _ = resolve_degraded_alpha(sched, faults,
+                                             worker_alive=member)
+    assert a_full == pytest.approx(float(sched.alpha), rel=1e-6)
+    # the composed solve equals the membership-only refold (no faults)
+    a_ref, r_ref, _ = sched.refold_for(member)
+    assert a_mem == pytest.approx(a_ref, rel=1e-6)
+    assert r_mem == pytest.approx(r_ref, rel=1e-6)
+    assert abs(a_mem - a_full) > 1e-4  # and it actually differs
+
+
+# ------------------------------------------------- e2e: journal + mixing
+
+def test_churn_journal_events_and_zero_retraces(churn_run):
+    result, run_dir, cfg = churn_run
+    events = _journal(run_dir, cfg)
+    mem = [e for e in events if e["kind"] == "membership"]
+    # epoch 0 re-folds for the 7-live start; then leave/join/rejoin
+    assert [e["epoch"] for e in mem] == [0, 1, 2, 3]
+    assert [sum(e["new_alive"]) for e in mem] == [7, 6, 7, 8]
+    kinds = [[t["kind"] for t in e["trigger"]] for e in mem]
+    assert kinds == [[], ["leave"], ["join"], ["rejoin"]]
+    for e in mem:
+        assert e["replanned"] is True  # hysteresis 0 = eager
+        assert np.isfinite(e["alpha"]) and e["alpha"] > 0
+        assert np.isfinite(e["rho"]) and 0 < e["rho"] <= 1.0
+        assert e["predicted"].get("rho") is not None  # drift re-base payload
+    # THE acceptance invariant: membership changes never grew the jit cache
+    assert [e for e in events if e["kind"] == "retrace"] == []
+
+
+def test_churn_final_disagreement_tight_vs_fault_free(churn_run, control_run):
+    result, _, _ = churn_run
+    elastic_d = result.history[-1]["disagreement"]
+    control_d = control_run.history[-1]["disagreement"]
+    assert np.isfinite(elastic_d) and elastic_d > 0
+    # the churned fleet ends within a small factor of the undisturbed one
+    assert elastic_d <= 5.0 * control_d + 1e-6
+
+
+def test_realized_mixing_doubly_stochastic_over_every_live_set(churn_run):
+    """For each intermediate live set, every realizable draw of the masked
+    mixing at that epoch's re-derived α is doubly stochastic over the live
+    rows to 1e-6 — singleton draws + the all-on draw prove all 2^M subsets
+    (row/col sums are linear in the draw; planlint's PL004 argument)."""
+    from matcha_tpu.plan.spectral import masked_laplacian_expectation
+    from matcha_tpu.topology import matching_laplacians, select_graph
+
+    result, run_dir, cfg = churn_run
+    events = _journal(run_dir, cfg)
+    decomposed = select_graph(5)
+    Ls = matching_laplacians(decomposed, 8)
+    eye = np.eye(8)
+    for e in (ev for ev in events if ev["kind"] == "membership"):
+        alive = np.asarray(e["new_alive"], np.float64)
+        live = alive > 0
+        alpha = float(e["alpha"])
+        mLs = masked_laplacian_expectation(Ls, alive)
+        draws = [eye - alpha * mLs[j] for j in range(mLs.shape[0])]
+        draws.append(eye - alpha * mLs.sum(axis=0))
+        for W in draws:
+            sub = W[np.ix_(live, live)]
+            assert np.max(np.abs(sub - sub.T)) < 1e-6
+            assert np.max(np.abs(sub.sum(axis=0) - 1.0)) < 1e-6
+            assert np.max(np.abs(sub.sum(axis=1) - 1.0)) < 1e-6
+            if (~live).any():
+                # dead rows ride identity self-loops: nothing leaks in/out
+                assert np.max(np.abs(W[~live][:, live])) < 1e-12
+                assert np.max(np.abs(W[np.ix_(~live, ~live)] - eye[
+                    np.ix_(~live, ~live)])) < 1e-12
+
+
+def test_masked_executor_matches_dense_oracle():
+    """The gather executor under an alive mask realizes exactly the masked
+    dense W — the mixing the doubly-stochastic check above verified."""
+    from matcha_tpu.parallel import gossip_mix
+    from matcha_tpu.plan.spectral import masked_laplacian_expectation
+    from matcha_tpu.topology import (
+        matching_laplacians,
+        matchings_to_perms,
+        select_graph,
+    )
+
+    decomposed = select_graph(5)
+    n = 8
+    perms = matchings_to_perms(decomposed, n)
+    Ls = matching_laplacians(decomposed, n)
+    alive = np.asarray([1, 1, 1, 0, 1, 1, 1, 0], np.float64)
+    alpha = 0.55
+    weights = alpha * np.asarray([1.0, 0.0, 1.0, 1.0][:perms.shape[0]],
+                                 np.float32)
+    x = np.random.default_rng(0).normal(size=(n, 5)).astype(np.float32)
+    got = np.asarray(gossip_mix(
+        jax.numpy.asarray(x), perms, jax.numpy.asarray(weights),
+        jax.numpy.asarray(alive, jax.numpy.float32)))
+    mLs = masked_laplacian_expectation(Ls, alive)
+    W = np.eye(n) - np.tensordot(np.asarray(weights, np.float64), mLs,
+                                 axes=1)
+    np.testing.assert_allclose(got, (W @ x.astype(np.float64)), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_elastic_step_single_trace_across_membership_changes():
+    """``check_single_trace`` on the compiled elastic step while the alive
+    mask and α scale change value mid-stream — the ISSUE-9 no-retrace proof
+    at the unit level (the e2e above proves it via the journal watch)."""
+    from matcha_tpu import topology as tp
+    from matcha_tpu.analysis import check_single_trace, retrace_guard
+    from matcha_tpu.communicator import make_decen
+    from matcha_tpu.data import synthetic_classification
+    from matcha_tpu.elastic.runtime import membership_arrays
+    from matcha_tpu.models import select_model
+    from matcha_tpu.schedule import matcha_schedule
+    from matcha_tpu.train.lr import make_lr_schedule
+    from matcha_tpu.train.state import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    n = 8
+    sched = matcha_schedule(tp.select_graph(5), n, iterations=8, budget=0.5,
+                            seed=0)
+    comm = make_decen(sched, backend="dense")
+    ds = synthetic_classification(num_train=256, num_test=32, seed=0)
+    model = select_model("mlp", "synthetic", num_classes=ds.num_classes)
+    lr = make_lr_schedule(0.1, 4, warmup=False)
+    opt = make_optimizer(lr, momentum=0.9, weight_decay=0.0, nesterov=False)
+    state, flattener = init_train_state(model, ds.x_train.shape[1:], n, opt,
+                                        comm, seed=0)
+    step = make_train_step(model, opt, comm, flattener, sched.flags,
+                           lr_schedule=lr, elastic=True)
+    guarded, counter = retrace_guard(step)
+    rng = jax.random.PRNGKey(0)
+    xb = jax.numpy.asarray(ds.x_train[: n * 4]).reshape(
+        (n, 4) + ds.x_train.shape[1:])
+    yb = jax.numpy.asarray(ds.y_train[: n * 4]).reshape(n, 4)
+    masks = [np.ones(n), np.asarray([1, 1, 1, 0, 1, 1, 1, 1]),
+             np.asarray([1, 1, 1, 0, 1, 1, 1, 0])]
+    scales = [1.0, 0.8, 1.2]
+    for mask, scale in zip(masks, scales):
+        state = state.replace(membership=membership_arrays(mask, scale))
+        state, metrics = guarded(state, xb, yb, rng)
+        assert float(metrics["alive_workers"]) == float(np.sum(mask))
+    jax.block_until_ready(state.params)
+    check_single_trace(counter, label="elastic_step")
+    assert counter.count == 1
+
+
+# ------------------------------------------- checkpoint / restore across N
+
+def test_resume_byte_identical_through_shrink_checkpoint(churn_run,
+                                                         shrink_ckpt):
+    full, _, _ = churn_run
+    ckpt, tmp = shrink_ckpt
+    resumed = train(_cfg(tmp, membership_trace=dict(TRACE)),
+                    resume_dir=ckpt)
+    for a, b in zip(jax.tree_util.tree_leaves(full.state.params),
+                    jax.tree_util.tree_leaves(resumed.state.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_byte_identical_through_grow_checkpoint(churn_run, grow_ckpt):
+    full, _, _ = churn_run
+    ckpt, tmp = grow_ckpt
+    resumed = train(_cfg(tmp, membership_trace=dict(TRACE)),
+                    resume_dir=ckpt)
+    for a, b in zip(jax.tree_util.tree_leaves(full.state.params),
+                    jax.tree_util.tree_leaves(resumed.state.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_onto_larger_live_set_trains_on(shrink_ckpt):
+    """The 6-live checkpoint restores into a run whose replayed boundary
+    occupancy is LARGER (this run's trace never lost w3, and adds a fresh
+    joiner): slot 3 is live now but the checkpointed row was quarantined
+    at save time — it bootstraps from the continuing members — and the
+    grown fleet trains on."""
+    ckpt, tmp = shrink_ckpt
+    grown = {"initial": TRACE["initial"],
+             "events": [{"kind": "join", "epoch": 2, "worker": "x9"}]}
+    result = train(_cfg(tmp, name="onto-larger", membership_trace=grown,
+                        epochs=4),
+                   resume_dir=ckpt)
+    assert result.history[-1]["epoch"] == 3
+    assert np.isfinite(result.history[-1]["loss"])
+    # 7 live at the restored boundary (vs 6 checkpointed), 8 after the join
+    assert result.history[-1]["alive_workers"] == pytest.approx(8.0)
+
+
+def test_restore_onto_smaller_live_set_trains_on(shrink_ckpt):
+    """The same checkpoint restores onto a SMALLER live set (this run's
+    trace also lost w5 before the boundary): the departed rows quarantine
+    and the 5 survivors train on."""
+    ckpt, tmp = shrink_ckpt
+    shrunk = {"initial": TRACE["initial"],
+              "events": [{"kind": "leave", "epoch": 1, "worker": "w3"},
+                         {"kind": "leave", "epoch": 1, "worker": "w5"}]}
+    result = train(_cfg(tmp, name="onto-smaller", membership_trace=shrunk,
+                        epochs=4),
+                   resume_dir=ckpt)
+    assert result.history[-1]["epoch"] == 3
+    assert np.isfinite(result.history[-1]["loss"])
+    assert result.history[-1]["alive_workers"] == pytest.approx(5.0)
+
+
+def test_membership_sidecar_written_next_to_checkpoint(shrink_ckpt):
+    from matcha_tpu.train.checkpoint import load_membership_sidecar
+
+    ckpt, _ = shrink_ckpt
+    side = load_membership_sidecar(ckpt, 1)
+    assert side is not None
+    view = side["view"]
+    assert view["pool_size"] == 8
+    assert view["occupants"][3] is None  # w3 left at epoch 1
+    assert view["owners"][3] == "w3"     # ...but still owns its slot
+    assert side["alpha"] > 0 and side["alpha_scale"] > 0
+
+
+# ----------------------------------------------------- offline policy scorer
+
+def test_elasticity_policy_scorer_and_artifact(tmp_path):
+    from matcha_tpu.analysis import lint_plan_file
+    from matcha_tpu.elastic.policy import (
+        elasticity_artifact,
+        score_elasticity_policies,
+    )
+    from matcha_tpu.plan import save_plan
+    from matcha_tpu.topology import select_graph
+
+    trace = load_membership_trace(TRACE)
+    report = score_elasticity_policies(
+        select_graph(5), 8, 0.5, trace, seed=3, steps_per_epoch=8,
+        trials=2, hysteresis=(0, 2), solver_iters=400)
+    pols = report["policies"]
+    assert len(pols) == 4  # {eager, hysteresis-2} × {mean, restore}
+    assert all(np.isfinite(p["score"]) and p["score"] > 0 for p in pols)
+    assert pols == sorted(pols, key=lambda p: p["score"])
+    for p in pols:
+        assert len(p["error_curve"]) == report["sim"]["epochs"]
+        if p["replan"] == "eager":
+            # eager α re-derives at every change; hysteresis-2 legitimately
+            # never folds mid-churn here (each change resets its clock) and
+            # lands back on the full-pool α once the fleet is whole again
+            assert len(set(np.round(p["alpha_by_epoch"], 9))) > 1
+    # the artifact is a real plan-format member and planlint-verifies
+    art = elasticity_artifact(report, {"graphid": 5})
+    path = tmp_path / "elasticity_plan.json"
+    save_plan(art, str(path))
+    violations, is_plan = lint_plan_file(str(path))
+    assert is_plan and violations == []
+    chosen = json.loads(path.read_text())["chosen"]
+    assert chosen["policy"]["replan"] in ("eager", "hysteresis-2")
+
+
+def test_policy_restore_equals_mean_without_rejoins():
+    """Property: the bootstrap policy can only matter where the trace
+    rejoins — a join-only trace scores identically under both."""
+    from matcha_tpu.elastic.policy import score_elasticity_policies
+    from matcha_tpu.topology import select_graph
+
+    trace = load_membership_trace({
+        "initial": ["a", "b", "c", "d", "e", "f"],
+        "events": [{"kind": "join", "epoch": 1, "worker": "g"}]})
+    report = score_elasticity_policies(
+        select_graph(5), 8, 0.5, trace, seed=1, steps_per_epoch=6,
+        trials=2, hysteresis=(0,), solver_iters=300)
+    by_boot = {p["bootstrap"]: p["score"] for p in report["policies"]}
+    assert by_boot["mean"] == pytest.approx(by_boot["restore"], rel=1e-12)

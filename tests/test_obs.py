@@ -167,14 +167,33 @@ def test_reference_journal_validates_line_by_line():
     """The committed artifact pins the schema: every line must validate,
     and the kinds the docs promise must actually occur.  Re-pinned at v2
     (ISSUE 8): the journal now carries the cost ledger's `compile` event
-    for the scanned-epoch program, populated on this CPU backend."""
+    for the scanned-epoch program, populated on this CPU backend.  ISSUE 9
+    re-pins with the elastic `membership` kind: the reference recipe churns
+    w3 (leave @2, rejoin @5), so both transitions — and their re-derived
+    α/ρ — are committed evidence, not just vocabulary."""
     events = read_journal(str(REPO / "benchmarks" / "events_ring8.jsonl"))
     assert events, "reference journal is empty"
     for i, e in enumerate(events):
         assert validate_event(e) == [], f"line {i + 1}: {validate_event(e)}"
     assert {e["v"] for e in events} == {2}
     kinds = {e["kind"] for e in events}
-    assert {"run_start", "epoch", "telemetry", "compile"} <= kinds
+    assert {"run_start", "epoch", "telemetry", "compile",
+            "membership"} <= kinds
+    leave, rejoin = [e for e in events if e["kind"] == "membership"]
+    assert (leave["epoch"], rejoin["epoch"]) == (2, 5)
+    assert [t["kind"] for t in leave["trigger"]] == ["leave"]
+    assert [t["kind"] for t in rejoin["trigger"]] == ["rejoin"]
+    assert (sum(leave["old_alive"]), sum(leave["new_alive"])) == (8.0, 7.0)
+    assert (sum(rejoin["old_alive"]), sum(rejoin["new_alive"])) == (7.0, 8.0)
+    for m in (leave, rejoin):
+        assert m["replanned"] is True  # hysteresis 0: eager re-fold
+        assert 0.0 < m["alpha"] < 1.0 and 0.0 < m["rho"] < 1.0
+    # w3's leave disconnects a ring edge pair ⇒ the 7-live set contracts
+    # worse than the full ring; the rejoin re-folds back to the pool plan
+    # exactly (alpha_scale 1 = executed α IS the schedule-built α again)
+    assert leave["rho"] > rejoin["rho"]
+    assert leave["alpha_scale"] != pytest.approx(1.0)
+    assert rejoin["alpha_scale"] == pytest.approx(1.0)
     start = events[0]
     assert start["kind"] == "run_start"
     assert 0.0 < start["predicted"]["rho"] < 1.0
@@ -209,10 +228,19 @@ def test_v1_events_validate_verbatim_and_v2_kinds_are_versioned():
     `compile`/`profile` event claiming v=1 is a lying envelope."""
     from matcha_tpu.obs.journal import EVENT_KINDS, V2_KINDS
 
-    assert V2_KINDS == {"compile", "profile"}
+    assert V2_KINDS == {"compile", "profile", "membership"}
     assert V2_KINDS <= EVENT_KINDS
     v1 = {"v": 1, "kind": "resume", "t": 0.5, "epoch": 3}
     assert validate_event(v1) == []
+    member = {"v": 2, "kind": "membership", "t": 1.0, "epoch": 2,
+              "old_alive": [1.0, 1.0], "new_alive": [1.0, 0.0],
+              "trigger": [{"kind": "leave", "epoch": 2, "worker": "w1"}],
+              "alpha": 0.5, "rho": 0.9, "replanned": True}
+    assert validate_event(member) == []
+    assert any("v2 kind" in p
+               for p in validate_event({**member, "v": 1}))
+    assert any("missing" in p for p in validate_event(
+        {k: v for k, v in member.items() if k != "alpha"}))
     v1_epoch = {"v": 1, "kind": "epoch", "t": 1.0, "epoch": 0,
                 "epoch_time": 1.0, "comp_time": 1.0, "comm_time": 0.0,
                 "train_loss": 2.3, "disagreement": 0.1}
@@ -618,6 +646,25 @@ def test_cli_summary_tail_and_markdown(ring8_run, tmp_path, capsys):
     assert text.startswith("# Run journal") and "| epoch |" in text
     assert obs_tpu.main(["tail", run_dir, "-n", "5"]) == 0
     assert "telemetry" in capsys.readouterr().out
+
+
+def test_summarize_dedupes_replayed_membership_events():
+    """A crash-resume replays its boundary reconciliation, journaling the
+    same membership transition again — summarize() must keep the latest
+    per epoch (the telemetry/epoch dedupe contract, journal.py), not list
+    the 8→7 transition twice."""
+    from matcha_tpu.obs.report import summarize
+
+    mem = {"v": 2, "kind": "membership", "epoch": 2,
+           "old_alive": [1.0] * 8, "new_alive": [1.0] * 7 + [0.0],
+           "trigger": [{"kind": "leave", "epoch": 2, "worker": "w7"}],
+           "alpha": 0.5, "rho": 0.9, "replanned": True}
+    events = [{**mem, "t": 1.0},
+              {**mem, "t": 9.0, "alpha": 0.6},  # the resume's replay
+              {**mem, "t": 5.0, "epoch": 4, "trigger": []}]
+    digest = summarize(events)
+    assert [e["epoch"] for e in digest["membership"]] == [2, 4]
+    assert digest["membership"][0]["alpha"] == 0.6  # latest wins
 
 
 def test_cli_drift_exit_codes(ring8_run, misplan_run, capsys):

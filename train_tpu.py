@@ -131,6 +131,28 @@ def parse_args(argv=None) -> TrainConfig:
                         "the SPMD step; e.g. "
                         '\'{"events": [{"kind": "dead", "worker": 3, '
                         '"start": 100, "stop": 200}]}\' in a file')
+    p.add_argument("--membership-trace", default=None,
+                   dest="membership_trace",
+                   help="JSON membership trace (elastic.MembershipTrace): "
+                        "join/leave/rejoin events of named workers applied "
+                        "at epoch boundaries — live workers map onto the "
+                        "static worker pool, the compiled step never "
+                        "retraces, and alpha/rho re-derive per live set; "
+                        'e.g. \'{"events": [{"kind": "leave", "epoch": 2, '
+                        '"worker": "w3"}]}\' in a file (DESIGN.md §16)')
+    p.add_argument("--membership-hysteresis", type=int, default=0,
+                   dest="membership_hysteresis",
+                   help="epochs the membership must hold still before the "
+                        "schedule is re-folded (alpha re-derived) for the "
+                        "new live set; 0 = eager re-plan. The alive mask "
+                        "always applies immediately. Score the trade-off "
+                        "offline with plan_tpu.py elasticity")
+    p.add_argument("--membership-bootstrap", default="mean",
+                   choices=["mean", "restore"], dest="membership_bootstrap",
+                   help="join/rejoin state policy: 'mean' bootstraps every "
+                        "(re)entering worker from the continuing members' "
+                        "average; 'restore' lets a rejoiner keep its own "
+                        "quarantined rows when still finite")
     p.add_argument("--max-recoveries", type=int, default=0,
                    dest="max_recoveries",
                    help="on a non-finite epoch: roll back to the last good "
@@ -215,6 +237,9 @@ def parse_args(argv=None) -> TrainConfig:
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         fault_plan=args.fault_plan, max_recoveries=args.max_recoveries,
         recovery_lr_backoff=args.recovery_lr_backoff,
+        membership_trace=args.membership_trace,
+        membership_hysteresis=args.membership_hysteresis,
+        membership_bootstrap=args.membership_bootstrap,
         telemetry=not args.no_telemetry,
         drift_tolerance=args.drift_tolerance,
         drift_patience=args.drift_patience,
