@@ -12,10 +12,15 @@ sequence, and physics-derived payloads are deterministic (fixed seed).
 The run carries a two-event membership churn (w3 leaves at epoch 2 and
 rejoins at epoch 5) so the journal pins the elastic ``membership`` kind —
 two events, both eagerly re-planned, bracketing the 8→7→8 live sets —
-alongside the cost ledger's ``compile`` event from the v1→v2 bump.
+alongside the cost ledger's ``compile`` event from the v1→v2 bump.  It
+also carries a fault-plan straggler (w5, period 4 over the 4-step epochs
+⇒ participation pinned at exactly 0.25) so the v3 health plane has real
+evidence to commit: one ``heartbeat`` per epoch and the streaming
+detector's ``straggler`` ``anomaly`` verdicts naming w5.
 
 Regenerate after a journal schema bump (the v1→v2 bump of ISSUE 8 added
-``compile`` events from the cost ledger; ISSUE 9 added ``membership``):
+``compile`` events from the cost ledger; ISSUE 9 added ``membership``;
+the v2→v3 bump of ISSUE 10 added ``heartbeat`` and ``anomaly``):
 
     JAX_PLATFORMS=cpu python benchmarks/make_reference_journal.py
 """
@@ -45,6 +50,12 @@ def main() -> int:
         membership_trace={"name": "ref_churn", "events": [
             {"kind": "leave", "epoch": 2, "worker": "w3"},
             {"kind": "rejoin", "epoch": 5, "worker": "w3"},
+        ]},
+        # the health plane's committed evidence: a period-4 straggler on
+        # w5 participates exactly 1 step in 4, so every heartbeat carries
+        # participation 0.25 and every epoch convicts one `anomaly`
+        fault_plan={"events": [
+            {"kind": "straggler", "worker": 5, "start": 0, "period": 4},
         ]},
     )
     # savePath stays the default relative "runs" so the journaled config

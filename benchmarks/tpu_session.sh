@@ -102,6 +102,22 @@ timeout -k 10 120 python obs_tpu.py profile \
     --md benchmarks/profile_r6.md --journal "$OBS_JOURNAL" \
     || echo "profile_r6: trace carried no device rows (CPU fallback?)"
 
+# 1.7 health_r6 (ISSUE 10: the live health plane's first on-TPU evidence).
+#     One short *saved* run so heartbeats land under {run}/health/, then
+#     the watch --once table as a committable markdown artifact — the
+#     per-worker alive/rate/participation table README's "Live health"
+#     section cites as queued.  A healthy fleet exits 0; a nonzero rc
+#     here on real hardware is itself a finding worth committing.
+rm -rf benchmarks/health_run_r6
+timeout -k 30 420 python train_tpu.py --name health_r6 \
+    --model mlp --dataset synthetic --graphid 2 --numworkers 16 \
+    --epoch 3 --backend auto --no-comm-split \
+    --save --savePath benchmarks/health_run_r6 > /dev/null
+timeout -k 10 120 python obs_tpu.py watch benchmarks/health_run_r6/health_r6_mlp \
+    --once --md benchmarks/health_r6.md \
+    || echo "health_r6: fleet flagged or no heartbeats (see table/stderr)"
+rm -rf benchmarks/health_run_r6
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist

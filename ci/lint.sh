@@ -22,6 +22,13 @@
 #   8. elasticity smoke  plan_tpu.py elasticity on a 2-event churn trace
 #                    — the scorer must rank the policy grid and emit an
 #                    artifact that passes its own planlint self-check
+#   9. health lane   live health plane (heartbeats, anomaly detectors,
+#                    watch CLI, live membership source), as pytest
+#                    (marker: health)
+#  10. watch smoke   obs_tpu.py watch --once on a journaled ring-4 CPU
+#                    run — must emit a real per-worker table and exit 0
+#                    on a healthy run (exit 1 is the flagged-fleet CI
+#                    gate; a false positive here would poison it)
 #
 # Fast pre-commit variant: lint only what changed vs a ref —
 #
@@ -86,5 +93,25 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python plan_tpu.py elasticity \
     --mc-trials 2 --out "$ELASTIC_DIR/elasticity_plan.json" \
     >/dev/null || rc=1
 rm -rf "$ELASTIC_DIR"
+
+echo "== health pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m health -p no:cacheprovider || rc=1
+
+echo "== watch smoke (journaled ring-4 CPU run, healthy -> exit 0) =="
+HEALTH_DIR="$(mktemp -d)"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python train_tpu.py \
+    --name watchsmoke --model mlp --dataset synthetic \
+    --graphid -1 --topology ring --numworkers 4 --bs 16 --epoch 2 \
+    --lr 0.05 --no-warmup --no-comm-split --save \
+    --savePath "$HEALTH_DIR" >/dev/null || rc=1
+WATCH_OUT="$(python obs_tpu.py watch "$HEALTH_DIR/watchsmoke_mlp" --once \
+    --deadline 86400)" || rc=1
+# a real table, not an empty shell: every worker row + the verdict line
+for w in w0 w1 w2 w3; do
+    grep -q "$w" <<<"$WATCH_OUT" || rc=1
+done
+grep -q 'verdict: HEALTHY' <<<"$WATCH_OUT" || rc=1
+rm -rf "$HEALTH_DIR"
 
 exit $rc

@@ -5,9 +5,13 @@ reconciler, and the epoch-boundary controller that re-folds the schedule
 over each new live set.  Device half (:mod:`.runtime`): the ``Membership``
 step input (no-retrace contract) and the jitted join/rejoin bootstrap.
 Offline half (:mod:`.policy`): score elasticity policies against a churn
-trace before committing to one (``plan_tpu.py elasticity``).
+trace before committing to one (``plan_tpu.py elasticity``).  Live half
+(:mod:`.live`): the heartbeat-watching :class:`LiveMembershipSource` —
+same interface as the trace loader, events derived from liveness
+(DESIGN.md §17).
 """
 
+from .live import LiveMembershipSource
 from .membership import (
     MEMBERSHIP_KINDS,
     ElasticController,
@@ -27,6 +31,7 @@ from .runtime import (
 __all__ = [
     "MEMBERSHIP_KINDS",
     "ElasticController",
+    "LiveMembershipSource",
     "Membership",
     "MembershipEvent",
     "MembershipTrace",

@@ -138,9 +138,13 @@ def load_membership_trace(
     spec: Union[str, dict, MembershipTrace, Sequence[MembershipEvent]],
 ) -> MembershipTrace:
     """Coerce any accepted spelling — a JSON file path (the CLI form), a
-    parsed dict, an event list, or an already-built trace."""
+    parsed dict, an event list, an already-built trace, or any object
+    satisfying the source interface (``start_view`` + ``at_epoch`` — the
+    :class:`elastic.live.LiveMembershipSource` duck type, DESIGN.md §17)."""
     if isinstance(spec, MembershipTrace):
         return spec
+    if hasattr(spec, "start_view") and hasattr(spec, "at_epoch"):
+        return spec  # a live (or custom) membership source: pass through
     if isinstance(spec, str):
         with open(spec) as f:
             return MembershipTrace.from_json(json.load(f))

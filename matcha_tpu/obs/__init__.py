@@ -25,8 +25,20 @@ Plus the *performance* twin (DESIGN.md §15, ISSUE 8):
   via the ``comm/*`` / ``matcha/*`` named scopes and the comm/comp
   overlap fraction (loud when a trace has no device rows).
 
-``obs_tpu.py`` renders a run's journal (summary / tail / drift / compare)
-and the performance artifacts (roofline / capacity / profile).
+And the *live* half (DESIGN.md §17, ISSUE 10):
+
+* :mod:`health` — per-host heartbeat files under ``{run}/health/``
+  (step progress, step-time EWMA, comm/compute split, per-worker
+  participation + disagreement) and the fleet-status digest behind
+  ``obs_tpu.py watch``.
+* :mod:`anomaly` — streaming MAD/robust-z detectors over those records
+  (dead / straggler / disagreement-outlier / time-spike /
+  deadline-missed), journaled as v3 ``anomaly`` events with an
+  attributed cause.
+
+``obs_tpu.py`` renders a run's journal (summary / tail / drift / compare),
+the performance artifacts (roofline / capacity / profile), and the live
+fleet status (watch / health).
 """
 
 from .costs import (
@@ -36,7 +48,14 @@ from .costs import (
     chip_peaks,
     roofline_report,
 )
+from .anomaly import ANOMALY_CAUSES, AnomalyDetector, mad_zscores
 from .drift import DriftMonitor, compose_predicted_rho, drift_report
+from .health import (
+    HeartbeatEmitter,
+    fleet_status,
+    read_heartbeats,
+    render_watch,
+)
 from .journal import (
     EVENT_KINDS,
     FAULT_KINDS,
@@ -54,10 +73,13 @@ from .telemetry import Telemetry, TelemetrySpec, telemetry_flush, telemetry_step
 from .xprof import TraceParseError, overlap_report, profile_report
 
 __all__ = [
+    "ANOMALY_CAUSES",
+    "AnomalyDetector",
     "CostLedger",
     "DriftMonitor",
     "EVENT_KINDS",
     "FAULT_KINDS",
+    "HeartbeatEmitter",
     "Journal",
     "SCHEMA_VERSION",
     "Telemetry",
@@ -65,16 +87,20 @@ __all__ = [
     "TraceParseError",
     "analyze_program",
     "append_journal_record",
+    "fleet_status",
     "capacity_report",
     "chip_peaks",
     "compose_predicted_rho",
     "drift_report",
     "epoch_series",
+    "mad_zscores",
     "make_event",
     "overlap_report",
     "profile_report",
+    "read_heartbeats",
     "read_journal",
     "read_journal_tail",
+    "render_watch",
     "resolve_journal_path",
     "roofline_report",
     "telemetry_flush",

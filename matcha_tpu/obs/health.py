@@ -1,0 +1,295 @@
+"""The live health plane: heartbeats per host, fleet status for the watch.
+
+Everything the repo's observability emitted before ISSUE 10 was post-hoc —
+the journal, the drift monitor, the profiler all explain a run after the
+fact.  This module is the *live* half: each host appends one ``heartbeat``
+record per epoch to its own file under a shared run directory, and anything
+on the same filesystem (``obs_tpu.py watch``, the anomaly detectors, the
+live membership source) reads the fleet's state while the run is in flight.
+
+Contract (DESIGN.md §17):
+
+* **Zero new device syncs.**  The emitter runs at the train loop's
+  existing per-epoch host-sync boundary and consumes only values already
+  on the host: the telemetry flush (the one sanctioned device read — its
+  count is pinned by test), the two-program comm split, and the cost
+  ledger's peak footprint.  ``step`` is host arithmetic, not a device
+  read.
+* **Per-host files, append-only.**  ``health/<host>.jsonl`` next to the
+  run's ``events.jsonl``; multi-host runs on a shared FS each append their
+  own file, so there is no cross-host write contention ever — readers list
+  the directory.  Records are journal-schema ``heartbeat`` events with
+  **absolute** unix ``t`` (liveness is a wall-clock question; the run
+  journal's copy keeps the run-relative clock like every other event).
+* **Torn-line tolerant reads.**  A watcher reads a writer's file mid-
+  append; the bounded reverse-tail reader (:func:`journal.read_journal_tail`)
+  drops a trailing partial line, so a concurrent append can never yield a
+  half record (pinned by test).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .anomaly import AnomalyDetector, liveness
+from .journal import append_journal_record, read_journal_tail
+
+__all__ = ["HeartbeatEmitter", "heartbeat_path", "read_heartbeats",
+           "worker_last_seen", "fleet_status", "render_watch"]
+
+
+def heartbeat_path(health_dir: str, host: str) -> str:
+    return os.path.join(health_dir, f"{host}.jsonl")
+
+
+class HeartbeatEmitter:
+    """Append one heartbeat per epoch to this host's file.
+
+    ``beat`` builds the payload (EWMA updated host-side), validates it
+    against the journal schema, appends it with absolute wall-time, and
+    returns the payload so the caller can mirror it into the run journal
+    (run-relative clock) — one record, two sinks, no drift between them.
+    """
+
+    def __init__(self, health_dir: str, host: str = "host0",
+                 ewma_alpha: float = 0.3):
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        self.health_dir = str(health_dir)
+        self.host = str(host)
+        self.path = heartbeat_path(self.health_dir, self.host)
+        self.ewma_alpha = float(ewma_alpha)
+        self._ewma: Optional[float] = None
+
+    def beat(self, epoch: int, step: int, steps: float, epoch_time: float,
+             comm_time: float, workers: Dict[str, dict],
+             peak_bytes: Optional[float] = None) -> dict:
+        """One epoch's heartbeat.  ``workers`` maps worker id →
+        ``{"slot", "participation", "disagreement"}`` (member slots only —
+        a vacant pool slot is nobody's worker and heartbeats for no one).
+        """
+        step_time = float(epoch_time) / max(float(steps), 1.0)
+        a = self.ewma_alpha
+        self._ewma = (step_time if self._ewma is None
+                      else a * step_time + (1.0 - a) * self._ewma)
+        comm = min(float(comm_time), float(epoch_time))
+        payload = {
+            "host": self.host,
+            "epoch": int(epoch),
+            "step": int(step),
+            "steps": float(steps),
+            "step_time": step_time,
+            "step_time_ewma": float(self._ewma),
+            "comp_time": float(epoch_time) - comm,
+            "comm_time": comm,
+            "peak_bytes": (None if peak_bytes is None
+                           else float(peak_bytes)),
+            "workers": {str(w): {k: (None if v is None else
+                                     (int(v) if k == "slot" else float(v)))
+                                 for k, v in stats.items()}
+                        for w, stats in workers.items()},
+        }
+        append_journal_record(self.path, "heartbeat", **payload)
+        return payload
+
+
+def read_heartbeats(health_dir: str, tail: int = 8) -> Dict[str, List[dict]]:
+    """``{host: [records]}`` — the last ``tail`` records of every per-host
+    file, oldest→newest, via the bounded reverse reader (O(tail), and a
+    concurrent writer's partial final line is dropped, never torn).
+
+    ``events.jsonl`` is never a heartbeat file: the run journal mirrors
+    heartbeats on the run-relative clock, so reading it as liveness
+    evidence would convict every worker of a ~unix-epoch-sized absence."""
+    out: Dict[str, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(health_dir, "*.jsonl"))):
+        if os.path.basename(path) == "events.jsonl":
+            continue
+        host = os.path.splitext(os.path.basename(path))[0]
+        records = [e for e in read_journal_tail(path, tail)
+                   if e.get("kind") == "heartbeat"]
+        if records:
+            out[host] = records
+    return out
+
+
+def worker_last_seen(records_by_host: Dict[str, List[dict]]
+                     ) -> Dict[str, float]:
+    """``{worker: last_seen_t}`` — the newest absolute timestamp of any
+    heartbeat that lists the worker as a member.  A worker a host stopped
+    listing (it left the live set) keeps its frozen last-seen, which is
+    exactly the signal the liveness deadline turns into a ``leave``."""
+    seen: Dict[str, float] = {}
+    for records in records_by_host.values():
+        for rec in records:
+            t = float(rec.get("t", 0.0))
+            for worker in (rec.get("workers") or {}):
+                if t >= seen.get(worker, -np.inf):
+                    seen[worker] = t
+    return seen
+
+
+def _resolve_health_dir(source: str) -> str:
+    """A run directory (holding ``health/``) or the health dir itself.
+
+    A directory whose only journal is a run ``events.jsonl`` is a run dir
+    *without* heartbeats (health off, or the dir deleted), not a
+    heartbeat directory — its run-relative clocks must never be read as
+    liveness evidence."""
+    nested = os.path.join(source, "health")
+    if os.path.isdir(nested):
+        return nested
+    if os.path.isdir(source) and any(
+            os.path.basename(p) != "events.jsonl"
+            for p in glob.glob(os.path.join(source, "*.jsonl"))):
+        return source
+    raise FileNotFoundError(
+        f"{source} holds no health/ heartbeat directory — was the run "
+        f"saved with health on (TrainConfig.save + health / --save)?")
+
+
+def fleet_status(source: str, now: Optional[float] = None,
+                 deadline: float = 60.0, tail: int = 8,
+                 detector: Optional[AnomalyDetector] = None) -> dict:
+    """Digest the fleet's heartbeat files into the watch table.
+
+    Re-runs the streaming detectors over each host's tail window (the
+    same pure-host code the train loop journals with — replaying records
+    reaches the same verdicts) and adds the one check only a reader can
+    make: deadline-missed liveness against ``now``.  Returns a dict with
+    per-worker ``rows``, per-host digests, and ``flagged`` — the
+    ``watch --once`` exit-1 verdict.
+    """
+    health_dir = _resolve_health_dir(source)
+    now = time.time() if now is None else float(now)
+    by_host = read_heartbeats(health_dir, tail=tail)
+    if not by_host:
+        raise FileNotFoundError(f"{health_dir} holds no heartbeat records")
+    detector = detector or AnomalyDetector()
+    # latest verdict per (subject, cause) across the tail window: a
+    # straggler flagged at epoch 3 stays on the table even if the chaos
+    # window closed before the newest beat
+    anomalies: Dict[tuple, dict] = {}
+    hosts: Dict[str, dict] = {}
+    for host, records in by_host.items():
+        for rec in records:
+            for a in detector.observe(rec):
+                anomalies[(a["subject"], a["cause"])] = a
+        newest = records[-1]
+        hosts[host] = {
+            "host": host,
+            "last_seen": float(newest.get("t", 0.0)),
+            "epoch": int(newest.get("epoch", -1)),
+            "step": int(newest.get("step", 0)),
+            "step_time_ewma": float(newest.get("step_time_ewma") or 0.0),
+            "steps_per_sec": (1.0 / float(newest["step_time_ewma"])
+                              if newest.get("step_time_ewma") else 0.0),
+            "workers": newest.get("workers") or {},
+        }
+    for host, age in liveness(
+            {h: d["last_seen"] for h, d in hosts.items()}, now,
+            deadline).items():
+        a = {"epoch": hosts[host]["epoch"], "subject": host,
+             "cause": "deadline_missed", "value": age,
+             "threshold": float(deadline)}
+        anomalies[(host, "deadline_missed")] = a
+        # a dark host's workers are presumed down with it
+        for worker in hosts[host]["workers"]:
+            anomalies[(worker, "deadline_missed")] = {**a, "subject": worker}
+    rates = [d["steps_per_sec"] for d in hosts.values()
+             if d["steps_per_sec"] > 0]
+    median_rate = float(np.median(rates)) if rates else 0.0
+    last_seen = worker_last_seen(by_host)
+    rows = []
+    for host, d in sorted(hosts.items()):
+        for worker, stats in sorted(d["workers"].items(),
+                                    key=lambda kv: (kv[1].get("slot") or 0,
+                                                    kv[0])):
+            # a dark host's deadline_missed already fanned out to each of
+            # its workers above, so the worker key alone is complete
+            flags = sorted(cause for (subj, cause) in anomalies
+                           if subj == worker)
+            rows.append({
+                "worker": worker,
+                "host": host,
+                "slot": stats.get("slot"),
+                "alive": "deadline_missed" not in flags
+                         and "dead" not in flags,
+                "last_seen_age": max(now - last_seen.get(worker, 0.0), 0.0),
+                "participation": stats.get("participation"),
+                "disagreement": stats.get("disagreement"),
+                "steps_per_sec": d["steps_per_sec"],
+                "rate_vs_median": (d["steps_per_sec"] / median_rate
+                                   if median_rate > 0 else None),
+                "flags": flags,
+            })
+    return {
+        "health_dir": health_dir,
+        "now": now,
+        "deadline": float(deadline),
+        "hosts": hosts,
+        "rows": rows,
+        "anomalies": sorted(anomalies.values(),
+                            key=lambda a: (a["epoch"], a["subject"],
+                                           a["cause"])),
+        "flagged": bool(anomalies),
+    }
+
+
+def _fmt(v, digits: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def render_watch(status: dict, markdown: bool = False) -> str:
+    """The fleet-status table (``obs_tpu.py watch``), terminal or markdown."""
+    head = (f"fleet health: {status['health_dir']} "
+            f"({len(status['hosts'])} host(s), {len(status['rows'])} "
+            f"worker(s), deadline {status['deadline']:.0f}s)")
+    verdict = ("HEALTHY" if not status["flagged"] else
+               f"ANOMALOUS ({len(status['anomalies'])} finding(s))")
+    cols = ("worker", "host", "alive", "seen[s]", "rate/med", "partic",
+            "disagree", "flags")
+
+    def cells(r):
+        return (r["worker"], r["host"], "yes" if r["alive"] else "NO",
+                _fmt(r["last_seen_age"]), _fmt(r["rate_vs_median"]),
+                _fmt(r["participation"]), _fmt(r["disagreement"]),
+                ",".join(r["flags"]) or "-")
+
+    if markdown:
+        lines = [f"# Fleet health — {os.path.basename(status['health_dir'].rstrip('/'))}",
+                 "", f"- {head}", f"- verdict: **{verdict}**", "",
+                 "| " + " | ".join(cols) + " |",
+                 "|" + "|".join("---" for _ in cols) + "|"]
+        lines += ["| " + " | ".join(str(c) for c in cells(r)) + " |"
+                  for r in status["rows"]]
+        if status["anomalies"]:
+            lines += ["", "## Anomalies", ""]
+            lines += [f"- `e{a['epoch']}` **{a['subject']}** {a['cause']} "
+                      f"(value {_fmt(a['value'])}, threshold "
+                      f"{_fmt(a['threshold'])})"
+                      for a in status["anomalies"]]
+        return "\n".join(lines) + "\n"
+    widths = [max(len(c), *(len(str(x)) for x in
+                            (tuple(cells(r))[i] for r in status["rows"])))
+              if status["rows"] else len(c) for i, c in enumerate(cols)]
+    lines = [head,
+             " ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in status["rows"]:
+        lines.append(" ".join(str(c).ljust(w)
+                              for c, w in zip(cells(r), widths)))
+    for a in status["anomalies"]:
+        lines.append(f"ANOMALY e{a['epoch']} {a['subject']}: {a['cause']} "
+                     f"(value {_fmt(a['value'])} vs threshold "
+                     f"{_fmt(a['threshold'])})")
+    lines.append(f"verdict: {verdict}")
+    return "\n".join(lines)

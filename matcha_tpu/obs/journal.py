@@ -35,17 +35,21 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
 __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
-           "FAULT_KINDS", "V2_KINDS", "REQUIRED_FIELDS",
+           "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "KIND_MIN_VERSION",
+           "REQUIRED_FIELDS",
            "make_event", "validate_event", "Journal", "read_journal",
            "read_journal_tail", "resolve_journal_path", "latest_per_epoch",
            "epoch_series", "append_journal_record"]
 
 #: v2 (ISSUE 8) adds only new kinds — ``compile`` (the cost ledger's
 #: program introspection) and ``profile`` (overlap-truth trace analysis).
-#: Every v1 event validates verbatim under the v2 reader: the version bump
-#: is additive by design, so pre-v2 journals stay first-class sources.
-SCHEMA_VERSION = 2
-ACCEPTED_VERSIONS = frozenset({1, 2})
+#: v3 (ISSUE 10) is additive again: ``heartbeat`` (the live health plane's
+#: per-host liveness/progress record, mirrored from the per-host heartbeat
+#: files under ``health/``) and ``anomaly`` (a streaming detector's verdict
+#: with an attributed cause).  Every v1/v2 event validates verbatim under
+#: the v3 reader — pre-bump journals stay first-class sources.
+SCHEMA_VERSION = 3
+ACCEPTED_VERSIONS = frozenset({1, 2, 3})
 
 #: Every kind a journal may contain.  The five fault kinds keep their
 #: historical ``faults.json`` names so the view stays a pure filter.
@@ -58,10 +62,19 @@ FAULT_KINDS = frozenset({
 #: reconciliations at epoch boundaries, carrying the re-derived α/ρ so
 #: drift replay re-bases exactly where the live monitor did.
 V2_KINDS = frozenset({"compile", "profile", "membership"})
+#: Kinds introduced by schema v3 (ISSUE 10) — invalid inside a v1/v2 event
+#: for the same reason.  ``heartbeat`` carries per-host progress + the
+#: per-worker stats the anomaly detectors read; ``anomaly`` carries one
+#: detector verdict (subject + attributed cause).
+V3_KINDS = frozenset({"heartbeat", "anomaly"})
+#: Minimum envelope version per kind — the generalized "a vK kind claiming
+#: an earlier v is a lying envelope" rule.
+KIND_MIN_VERSION: Dict[str, int] = {
+    **{k: 2 for k in V2_KINDS}, **{k: 3 for k in V3_KINDS}}
 EVENT_KINDS = frozenset({
     "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
     "retrace", "bench",
-}) | FAULT_KINDS | V2_KINDS
+}) | FAULT_KINDS | V2_KINDS | V3_KINDS
 
 #: Kind-specific payload keys an event must carry to validate.  Kinds not
 #: listed need only the envelope (v / kind / t).
@@ -91,6 +104,17 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # fold; ``predicted`` carries the re-based composition for drift replay)
     "membership": frozenset({"epoch", "old_alive", "new_alive", "trigger",
                              "alpha", "rho", "replanned"}),
+    # v3 (ISSUE 10): one per host per epoch boundary (obs.health) — step
+    # progress, step-time EWMA, the comm/compute split, peak footprint from
+    # the cost ledger, and the per-worker stats the detectors consume
+    # (``workers`` maps worker id -> {slot, participation, disagreement})
+    "heartbeat": frozenset({"host", "epoch", "step", "step_time",
+                            "step_time_ewma", "comp_time", "comm_time",
+                            "peak_bytes", "workers"}),
+    # v3: one per detector verdict (obs.anomaly) — ``subject`` is the
+    # worker or host being accused, ``cause`` the attributed failure mode
+    "anomaly": frozenset({"epoch", "subject", "cause", "value",
+                          "threshold"}),
 }
 
 
@@ -110,8 +134,9 @@ def validate_event(event: dict) -> List[str]:
     kind = event.get("kind")
     if kind not in EVENT_KINDS:
         problems.append(f"unknown kind {kind!r}")
-    elif kind in V2_KINDS and isinstance(v, int) and v < 2:
-        problems.append(f"{kind} is a v2 kind but event claims v={v}")
+    elif isinstance(v, int) and v < KIND_MIN_VERSION.get(kind, 1):
+        problems.append(f"{kind} is a v{KIND_MIN_VERSION.get(kind, 1)} "
+                        f"kind but event claims v={v}")
     t = event.get("t")
     if not isinstance(t, (int, float)) or not t >= 0:
         problems.append(f"t={t!r} is not a non-negative number")
@@ -270,14 +295,23 @@ def resolve_journal_path(source: str) -> str:
     return source
 
 
-def latest_per_epoch(events: Iterable[dict], kind: str) -> Dict[int, dict]:
+def latest_per_epoch(events: Iterable[dict], kind: str,
+                     key=None) -> Dict:
     """``{epoch: event}`` keeping the **last** event per epoch — the replay
     rule for resumed runs (the journal is append-only; a re-run epoch's
-    newer event supersedes the stale one)."""
-    out: Dict[int, dict] = {}
+    newer event supersedes the stale one).
+
+    ``key``: optional extractor widening the dedup key beyond the epoch —
+    kinds that legitimately journal several distinct events per epoch
+    (an ``anomaly`` per subject×cause, a ``heartbeat`` per host) dedupe
+    per ``(epoch, key(event))`` so a crash-resume's replayed copies
+    collapse while genuinely distinct events survive."""
+    out: Dict = {}
     for e in events:
         if e.get("kind") == kind and "epoch" in e:
-            out[int(e["epoch"])] = e
+            k = int(e["epoch"]) if key is None else (int(e["epoch"]),
+                                                     key(e))
+            out[k] = e
     return out
 
 

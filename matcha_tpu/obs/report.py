@@ -64,6 +64,18 @@ def summarize(events: List[dict]) -> Dict:
     # keep the latest per epoch, in epoch order
     membership = [e for _, e in
                   sorted(latest_per_epoch(events, "membership").items())]
+    # heartbeat/anomaly replay the same way on resume: dedupe per
+    # (epoch, host) and (epoch, subject, cause) keeping the latest — a
+    # replayed epoch's fresh verdict supersedes, distinct findings survive
+    heartbeats = [e for _, e in sorted(
+        latest_per_epoch(events, "heartbeat",
+                         key=lambda e: str(e.get("host"))).items(),
+        key=lambda kv: kv[0])]
+    anomalies = [e for _, e in sorted(
+        latest_per_epoch(events, "anomaly",
+                         key=lambda e: (str(e.get("subject")),
+                                        str(e.get("cause")))).items(),
+        key=lambda kv: kv[0])]
     drift = [e for e in events if e.get("kind") == "drift"]
     retrace = [e for e in events if e.get("kind") == "retrace"]
     bench = [e for e in events if e.get("kind") == "bench"]
@@ -75,6 +87,8 @@ def summarize(events: List[dict]) -> Dict:
         "rows": rows,
         "faults": faults,
         "membership": membership,
+        "heartbeat": heartbeats,
+        "anomaly": anomalies,
         "drift": drift,
         "retrace": retrace,
         "bench": bench,
@@ -135,6 +149,19 @@ def render_summary(events: List[dict], source: str = "events.jsonl") -> str:
             f"membership @e{e.get('epoch')}: {lives[0]}→{lives[1]} live "
             f"[{trig}] alpha={_fmt(e.get('alpha'))} rho={_fmt(e.get('rho'))}"
             f"{'' if e.get('replanned') else ' (re-plan deferred)'}")
+    if digest["heartbeat"]:
+        hosts = sorted({str(e.get("host")) for e in digest["heartbeat"]})
+        last = digest["heartbeat"][-1]
+        lines.append(
+            f"heartbeats: {len(digest['heartbeat'])} "
+            f"(hosts: {', '.join(hosts)}; last @e{last.get('epoch')} "
+            f"step {last.get('step')}, "
+            f"ewma {_fmt(last.get('step_time_ewma'), 3)}s/step)")
+    for e in digest["anomaly"]:
+        lines.append(
+            f"ANOMALY @e{e.get('epoch')}: {e.get('subject')} "
+            f"{e.get('cause')} (value {_fmt(e.get('value'))} vs threshold "
+            f"{_fmt(e.get('threshold'))})")
     for label, key in (("fault events", "faults"), ("drift events", "drift"),
                        ("retrace events", "retrace")):
         if digest[key]:
@@ -185,7 +212,12 @@ def render_summary_markdown(events: List[dict],
         lines.append("")
         lines.append(f"Total wire bytes: "
                      f"**{_fmt_bytes(digest['total_wire_bytes'])}**")
+    if digest["heartbeat"]:
+        hosts = sorted({str(e.get("host")) for e in digest["heartbeat"]})
+        lines += ["", f"Heartbeats: **{len(digest['heartbeat'])}** "
+                      f"(hosts: {', '.join(hosts)})"]
     for label, key in (("Fault", "faults"), ("Membership", "membership"),
+                       ("Anomaly", "anomaly"),
                        ("Drift", "drift"), ("Retrace", "retrace")):
         if digest[key]:
             lines += ["", f"## {label} events", ""]
@@ -299,6 +331,11 @@ def compare_sources(sources: Sequence[str]) -> Tuple[List[Dict], List[str]]:
                     "device_kind": None,
                     "mfu": None,
                     "wire_bytes": digest["total_wire_bytes"],
+                    # the health verdict travels with the run: a number
+                    # from an anomalous fleet is not comparable evidence
+                    "anomalies": (len(digest["anomaly"])
+                                  if digest["heartbeat"]
+                                  or digest["anomaly"] else None),
                 })
         except (OSError, ValueError, KeyError) as e:
             problems.append(f"{src}: {type(e).__name__}: {e}")
@@ -308,7 +345,7 @@ def compare_sources(sources: Sequence[str]) -> Tuple[List[Dict], List[str]]:
 def render_compare(rows: List[Dict], problems: List[str],
                    markdown: bool = False) -> str:
     cols = ("source", "value", "unit", "backend", "vs_baseline",
-            "device_kind", "mfu")
+            "device_kind", "mfu", "anomalies")
     if markdown:
         lines = ["| " + " | ".join(cols) + " |",
                  "|" + "|".join("---" for _ in cols) + "|"]

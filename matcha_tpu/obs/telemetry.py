@@ -39,11 +39,18 @@ __all__ = ["Telemetry", "TelemetrySpec", "make_telemetry_spec",
 
 
 class Telemetry(struct.PyTreeNode):
-    """Device-side per-epoch accumulator (all leaves f32 scalars).
+    """Device-side per-epoch accumulator (f32 scalars + two f32[N] rows).
 
     ``alive_min`` starts at ``+inf`` so the running ``minimum`` is exact
     from the first step; ``telemetry_flush`` maps a still-infinite value
     (an epoch of zero steps) to NaN rather than inventing a fleet size.
+
+    The two per-worker leaves (ISSUE 10) are what the health plane's
+    heartbeat attributes anomalies with: ``worker_alive_sum`` counts each
+    worker's participating steps (a fault-plan straggler participates
+    every period-th step, a dead worker not at all), and
+    ``worker_disagreement_sum`` accumulates each row's RMS deviation from
+    consensus — still read exactly once per epoch with everything else.
     """
 
     steps: jax.Array              # gossip/train steps accumulated
@@ -57,20 +64,26 @@ class Telemetry(struct.PyTreeNode):
     stale_dropped: jax.Array      # pending deltas dropped at heal (rows)
     quantized_values: jax.Array   # values rounded through a narrow wire
     healed: jax.Array             # rows healed from the survivor mean
+    worker_alive_sum: jax.Array   # f32[N] Σ per-worker participation
+    worker_disagreement_sum: jax.Array  # f32[N] Σ per-worker deviation
 
     @classmethod
-    def zeros(cls) -> "Telemetry":
+    def zeros(cls, num_workers: int) -> "Telemetry":
         # one fresh buffer per field: the scanned epoch *donates* the
         # state, and donation rejects the same buffer appearing twice —
         # a single shared zeros() would alias every leaf
         def z():
             return jnp.zeros((), jnp.float32)
 
+        def zn():
+            return jnp.zeros((int(num_workers),), jnp.float32)
+
         return cls(steps=z(), disagreement_sum=z(), disagreement_last=z(),
                    wire_bytes=z(), matchings=z(), alive_sum=z(),
                    alive_min=jnp.asarray(jnp.inf, jnp.float32),
                    stale_steps=z(), stale_dropped=z(), quantized_values=z(),
-                   healed=z())
+                   healed=z(), worker_alive_sum=zn(),
+                   worker_disagreement_sum=zn())
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,13 +136,18 @@ def telemetry_step(
     alive_count: jax.Array,
     healed: Optional[jax.Array] = None,
     stale_dropped: Optional[jax.Array] = None,
+    worker_alive: Optional[jax.Array] = None,
+    worker_disagreement: Optional[jax.Array] = None,
 ) -> Telemetry:
     """One step's accumulation — pure jnp, fused into the compiled step.
 
     ``flags_t: f32[M]`` is this step's activation row; the wire accounting
     is a dot with the spec's static per-matching vectors.  ``healed`` /
     ``stale_dropped`` are this step's heal counts (None when the fault
-    machinery is off — compiles the zero-cost path).
+    machinery is off — compiles the zero-cost path).  ``worker_alive`` /
+    ``worker_disagreement`` are this step's f32[N] participation mask and
+    per-row consensus deviation (None compiles the all-participating /
+    zero-deviation accumulation — the pre-health program's cost).
     """
     one = jnp.ones((), jnp.float32)
     zero = jnp.zeros((), jnp.float32)
@@ -149,6 +167,12 @@ def telemetry_step(
         quantized_values=tel.quantized_values
         + (wire_values if spec.quantizing else zero),
         healed=tel.healed + (healed if healed is not None else zero),
+        worker_alive_sum=tel.worker_alive_sum
+        + (worker_alive if worker_alive is not None
+           else jnp.ones_like(tel.worker_alive_sum)),
+        worker_disagreement_sum=tel.worker_disagreement_sum
+        + (worker_disagreement if worker_disagreement is not None
+           else jnp.zeros_like(tel.worker_disagreement_sum)),
     )
 
 
@@ -163,6 +187,12 @@ def telemetry_flush(tel: Any) -> Dict[str, float]:
     steps = float(np.asarray(tel.steps))
     denom = max(steps, 1.0)
     alive_min = float(np.asarray(tel.alive_min))
+    # per-worker stats (the health plane's attribution payload): each
+    # worker's participation fraction, and its mean deviation over the
+    # steps it actually participated in (a straggler's deviation must not
+    # be diluted by the steps it sat out)
+    w_alive = np.asarray(tel.worker_alive_sum, np.float64)
+    w_dev = np.asarray(tel.worker_disagreement_sum, np.float64)
     return {
         "steps": steps,
         "disagreement_mean": float(np.asarray(tel.disagreement_sum)) / denom,
@@ -175,4 +205,7 @@ def telemetry_flush(tel: Any) -> Dict[str, float]:
         "stale_dropped": float(np.asarray(tel.stale_dropped)),
         "quantized_values": float(np.asarray(tel.quantized_values)),
         "healed": float(np.asarray(tel.healed)),
+        "worker_participation": [float(v) for v in w_alive / denom],
+        "worker_disagreement": [float(v) for v in
+                                w_dev / np.maximum(w_alive, 1.0)],
     }

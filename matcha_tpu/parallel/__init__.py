@@ -5,6 +5,7 @@ from .collectives import (
     broadcast_worker0,
     masked_allreduce_mean,
     masked_mean_rows,
+    worker_deviation_rows,
     worker_disagreement,
 )
 from .gossip import (
@@ -55,5 +56,6 @@ __all__ = [
     "shard_map_gossip_fn",
     "shard_workers",
     "worker_mesh",
+    "worker_deviation_rows",
     "worker_disagreement",
 ]

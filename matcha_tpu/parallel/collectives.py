@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["allreduce_mean", "broadcast_worker0", "masked_mean_rows",
-           "masked_allreduce_mean", "worker_disagreement"]
+           "masked_allreduce_mean", "worker_disagreement",
+           "worker_deviation_rows"]
 
 
 def allreduce_mean(x: jax.Array) -> jax.Array:
@@ -75,3 +76,27 @@ def worker_disagreement(x: jax.Array, alive: jax.Array | None = None) -> jax.Arr
     # graftlint: disable=GL001 — scalar survivor count × row width, no values
     denom = jnp.maximum(jnp.sum(alive), 1.0) * (x.size // x.shape[0])
     return jnp.sqrt(jnp.sum(centered * centered) / denom)
+
+
+def worker_deviation_rows(x: jax.Array,
+                          alive: jax.Array | None = None) -> jax.Array:
+    """Per-worker RMS distance from consensus: f32[N] — row i's
+    ``‖x_i − x̄‖ / √D``.
+
+    The per-worker decomposition of :func:`worker_disagreement` (the fleet
+    scalar is the alive-weighted RMS of these rows): what the health
+    plane's heartbeat carries so the anomaly detectors can name *which*
+    replica is drifting, not just that the fleet is (DESIGN.md §17).  With
+    ``alive`` the consensus point is the survivor mean and quarantined
+    rows report 0 — their deviation is quarantine, not news; the
+    participation counter is the signal that names them."""
+    if alive is None:
+        centered = x - jnp.mean(x, axis=0, keepdims=True)
+    else:
+        w = alive.reshape((alive.shape[0],) + (1,) * (x.ndim - 1)).astype(
+            x.dtype)
+        # where, not multiply: a quarantined row may be non-finite
+        centered = jnp.where(w > 0, x - masked_mean_rows(x, alive)[None],
+                             jnp.zeros_like(x))
+    sq = (centered * centered).reshape(x.shape[0], -1)
+    return jnp.sqrt(jnp.mean(sq, axis=1))

@@ -130,6 +130,17 @@ class TrainConfig:
     # rejoiner keep its own quarantined rows when its slot is untouched
     # and still finite (momentum/carry/overlap-delta reset either way).
     membership_bootstrap: str = "mean"
+    # live membership (DESIGN.md §17): a heartbeat directory to watch (a
+    # run's health/ dir, or any directory of per-host heartbeat files), or
+    # — programmatically — membership_trace may itself be an
+    # elastic.LiveMembershipSource.  Missed-deadline ⇒ leave, reappearance
+    # ⇒ rejoin, through the same ElasticController the declared trace
+    # drives (parity pinned by test).  Mutually exclusive with
+    # membership_trace.
+    membership_live: Optional[str] = None
+    # seconds without a heartbeat before a member is presumed gone (and a
+    # non-member's heartbeat counts as an arrival)
+    membership_deadline: float = 60.0
 
     # observability (DESIGN.md §14).  telemetry=True threads the
     # obs.Telemetry scalar accumulator through the compiled step (a handful
@@ -139,6 +150,14 @@ class TrainConfig:
     # off it still records run_start/epoch/fault/checkpoint events, just no
     # telemetry flushes or drift trips.
     telemetry: bool = True
+    # live health plane (DESIGN.md §17): append one heartbeat record per
+    # epoch to {run}/health/{host}.jsonl (step progress, step-time EWMA,
+    # comm/compute split, peak footprint, per-worker participation +
+    # disagreement) and run the streaming anomaly detectors over it,
+    # journaling `anomaly` events with an attributed cause.  Pure host
+    # work riding the existing epoch sync — needs save (a run folder) and
+    # telemetry (the per-worker stats) to be on; False disables only this.
+    health: bool = True
     # drift monitor: journal a `drift` event when the measured per-epoch
     # disagreement contraction exceeds the plan's predicted factor
     # (rho^(steps/2), staleness/wire/fault-composed) by more than
@@ -261,3 +280,19 @@ class TrainConfig:
                 "membership_trace needs a communicator: a joining worker "
                 "bootstraps from its peers' consensus, which requires a "
                 "mixing process to rejoin")
+        if self.membership_live is not None:
+            if self.membership_trace is not None:
+                raise ValueError(
+                    "membership_live and membership_trace are mutually "
+                    "exclusive — one membership source per run (pass a "
+                    "LiveMembershipSource as membership_trace for a "
+                    "pre-built live source)")
+            if self.communicator == "none":
+                raise ValueError(
+                    "membership_live needs a communicator: a joining worker "
+                    "bootstraps from its peers' consensus, which requires a "
+                    "mixing process to rejoin")
+        if not self.membership_deadline > 0:
+            raise ValueError(
+                f"membership_deadline must be > 0, got "
+                f"{self.membership_deadline}")

@@ -21,6 +21,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -190,11 +191,24 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     # so — unlike the recovery path's α re-derivation — nothing recompiles
     # and `schedule` itself is never rebound by a membership change.
     elastic_ctl = None
-    if config.membership_trace is not None:
-        from ..elastic import ElasticController, load_membership_trace
+    membership_source = None
+    if config.membership_live is not None:
+        # the live half (DESIGN.md §17): membership events derived from
+        # heartbeat liveness instead of a declaration — the controller and
+        # everything downstream are identical (parity pinned by test)
+        from ..elastic import LiveMembershipSource
+
+        membership_source = LiveMembershipSource(
+            config.membership_live, deadline=config.membership_deadline)
+    elif config.membership_trace is not None:
+        from ..elastic import load_membership_trace
+
+        membership_source = load_membership_trace(config.membership_trace)
+    if membership_source is not None:
+        from ..elastic import ElasticController
 
         elastic_ctl = ElasticController(
-            load_membership_trace(config.membership_trace),
+            membership_source,
             config.num_workers,
             hysteresis=config.membership_hysteresis,
             bootstrap=config.membership_bootstrap,
@@ -266,7 +280,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         epoch (the retrace watch caught exactly this).  Fresh buffers each
         time — the scanned epoch donates the state, so a reused template
         would be invalidated by the very epoch that consumed it."""
-        tel = Telemetry.zeros()
+        tel = Telemetry.zeros(config.num_workers)
         return shard_workers(tel, mesh) if mesh is not None else tel
 
     def _fresh_membership():
@@ -419,6 +433,22 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             # how one checkpoint restores onto a larger or smaller live set
             from .checkpoint import load_membership_sidecar
 
+            if hasattr(membership_source, "seed_replay"):
+                # a live source's poll cache died with the old process:
+                # re-polling history against today's clock would diverge
+                # from the run being resumed (a recovered host would
+                # retro-actively never have left) — seed the cache from
+                # the journal, its persisted copy.  A missing journal
+                # (resume into a fresh savePath) replays live and lets
+                # the sidecar reconcile + the next real poll converge.
+                journal_path = os.path.join(
+                    config.savePath, f"{config.name}_{config.model}",
+                    "events.jsonl")
+                if os.path.exists(journal_path):
+                    from ..obs.journal import read_journal
+
+                    membership_source.seed_replay(
+                        read_journal(journal_path), start_epoch)
             elastic_ctl.replay_to(start_epoch, schedule)
             member_alive_np = elastic_ctl.alive_mask() > 0
             side = load_membership_sidecar(resume_dir, last_epoch)
@@ -440,6 +470,31 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     # peak footprint, arg shardings, compile wall-time.  One extra AOT
     # compile per distinct program, gated with the rest of observability.
     cost_ledger = CostLedger(recorder.log_event) if config.telemetry else None
+    # live health plane (DESIGN.md §17): one heartbeat per epoch to this
+    # host's file under {run}/health/, plus the streaming anomaly
+    # detectors over exactly those records.  Pure host code consuming
+    # values already read at this boundary — needs save (a folder) and
+    # telemetry (the per-worker stats ride the accumulator's one flush).
+    health_emitter = anomaly_detector = None
+    if config.health and config.save and config.telemetry:
+        from ..obs.anomaly import AnomalyDetector
+        from ..obs.health import HeartbeatEmitter
+
+        health_emitter = HeartbeatEmitter(
+            os.path.join(recorder.folder, "health"),
+            host=f"host{jax.process_index()}")
+        anomaly_detector = AnomalyDetector()
+
+    def _member_workers(worker_stats):
+        """Heartbeat payload: worker id → per-worker stats, member slots
+        only (a vacant pool slot is nobody's worker — its frozen row's
+        numbers would accuse a ghost)."""
+        occupants = (elastic_ctl.view.occupants if elastic_ctl is not None
+                     else [f"w{i}" for i in range(config.num_workers)])
+        return {wid: {"slot": i,
+                      "participation": worker_stats["worker_participation"][i],
+                      "disagreement": worker_stats["worker_disagreement"][i]}
+                for i, wid in enumerate(occupants) if wid is not None}
     if start_epoch and config.save:
         # re-align the CSV series with the restored epoch: reload the
         # previous run's rows truncated to the checkpoint, so save() extends
@@ -863,6 +918,12 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             # epoch-boundary sync that already happened above; the
             # accumulator then resets for the next epoch's window
             tel = telemetry_flush(state.telemetry)
+            # the per-worker stats ride the same flush but feed the
+            # heartbeat, not the telemetry event (its scalar schema is
+            # pinned; attribution lives in the health plane)
+            worker_stats = {
+                "worker_participation": tel.pop("worker_participation"),
+                "worker_disagreement": tel.pop("worker_disagreement")}
             recorder.log_event("telemetry", epoch=epoch, **tel)
             state = state.replace(telemetry=_fresh_telemetry())
             if drift_monitor is not None:
@@ -870,6 +931,21 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                                               tel["disagreement_mean"])
                 if drift is not None:
                     recorder.log_event("drift", **drift)
+            if health_emitter is not None:
+                # step is host arithmetic (epoch boundary × batches/epoch),
+                # NOT a device read — the zero-new-syncs contract
+                peak = max((e.get("peak_bytes") or 0.0
+                            for e in cost_ledger.programs), default=0.0) \
+                    if cost_ledger is not None else 0.0
+                hb = health_emitter.beat(
+                    epoch=epoch, step=(epoch + 1) * bpe,
+                    steps=tel["steps"], epoch_time=epoch_time,
+                    comm_time=comm_time,
+                    workers=_member_workers(worker_stats),
+                    peak_bytes=peak or None)
+                recorder.log_event("heartbeat", **hb)
+                for a in anomaly_detector.observe(hb):
+                    recorder.log_event("anomaly", **a)
         _watch_retrace(e_scan if config.scan_epoch else e_step)
 
         if config.save and recorder.epochs_recorded % 10 == 0:

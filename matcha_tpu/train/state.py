@@ -31,7 +31,7 @@ from flax import struct
 from ..communicator import Communicator
 from ..obs.telemetry import telemetry_step
 from ..ops import WorkerFlattener
-from ..parallel import allreduce_mean, worker_disagreement
+from ..parallel import allreduce_mean, worker_deviation_rows, worker_disagreement
 from ..utils import cross_entropy_loss, device_span, top_k_accuracy
 
 __all__ = ["TrainState", "init_train_state", "make_train_step", "make_eval_fn", "make_optimizer"]
@@ -427,6 +427,11 @@ def make_train_step(
                 healed=heal_count,
                 # overlapped heal drops the healed rows' pending deltas
                 stale_dropped=(heal_count if overlap_on else None),
+                # the health plane's attribution payload (DESIGN.md §17):
+                # who participated this step, and each row's deviation
+                # from consensus — fused adds like every other counter
+                worker_alive=alive,
+                worker_disagreement=worker_deviation_rows(flat, alive),
             )
         return (
             state.replace(

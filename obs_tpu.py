@@ -57,6 +57,19 @@ Performance observability (DESIGN.md §15):
     per trace.  Exits 2 with a clear message when a trace has no device
     rows (a CPU capture) instead of reporting a fake 0%.
 
+Live health plane (DESIGN.md §17):
+
+``watch RUN [--once] [--interval S] [--deadline S] [--md PATH]``
+    (alias: ``health``)  Live fleet status from the per-host heartbeat
+    files under ``RUN/health/`` (bounded reverse-tail reads — O(tail) per
+    refresh, torn-line safe against concurrent writers): one row per
+    worker (alive, last-seen age, step-rate vs fleet median,
+    participation, disagreement, anomaly flags) plus every detector
+    verdict over the tail window.  ``--once`` prints a single table and
+    exits 1 when anything is flagged (the CI / scripting form; a healthy
+    fleet exits 0); without it the table refreshes every ``--interval``
+    seconds until interrupted.  Exits 2 when no heartbeats exist.
+
 ``RUN`` is a run directory (holding ``events.jsonl``) or a journal path.
 """
 
@@ -250,6 +263,33 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    import time
+
+    from matcha_tpu.obs.health import fleet_status, render_watch
+
+    def once() -> int:
+        status = fleet_status(args.run, deadline=args.deadline,
+                              tail=args.tail)
+        print(render_watch(status))
+        if args.md:
+            with open(args.md, "w") as f:
+                f.write(render_watch(status, markdown=True))
+            print(f"# markdown written to {args.md}", file=sys.stderr)
+        return 1 if status["flagged"] else 0
+
+    if args.once:
+        return once()
+    try:
+        while True:  # the live dashboard loop; ^C is the exit path
+            rc = once()
+            print(f"# refresh in {args.interval:.0f}s (^C to stop; "
+                  f"current verdict rc={rc})", file=sys.stderr)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__,
@@ -325,6 +365,26 @@ def main(argv=None) -> int:
                    help="comma-separated communicator column set")
     s.add_argument("--md", default=None)
     s.set_defaults(fn=cmd_capacity)
+
+    for name in ("watch", "health"):  # one command, both spellings
+        s = sub.add_parser(name,
+                           help="live fleet status from heartbeat files")
+        s.add_argument("run", help="run dir (holding health/) or a "
+                                   "heartbeat directory")
+        s.add_argument("--once", action="store_true",
+                       help="print one table and exit (1 when any worker "
+                            "is flagged — the CI form)")
+        s.add_argument("--interval", type=float, default=10.0,
+                       help="refresh period in seconds without --once")
+        s.add_argument("--deadline", type=float, default=60.0,
+                       help="seconds without a heartbeat before a host "
+                            "counts as deadline-missed")
+        s.add_argument("--tail", type=int, default=8,
+                       help="heartbeat records per host to re-run the "
+                            "detectors over (bounded reverse read)")
+        s.add_argument("--md", default=None,
+                       help="also write the table as a markdown artifact")
+        s.set_defaults(fn=cmd_watch)
 
     s = sub.add_parser("profile",
                        help="overlap truth from executed profiler traces")

@@ -1,0 +1,647 @@
+"""Live health plane (ISSUE 10): heartbeats, anomaly detectors, watch CLI,
+and the liveness-driven membership source.
+
+Layered like the subsystem: pure units (robust z-scores, liveness math,
+the streaming detectors), the heartbeat emitter's schema/EWMA/torn-line
+contracts, the fleet-status digest behind ``obs_tpu.py watch``, the
+declared-trace-vs-live parity pin for :class:`LiveMembershipSource`, and
+the chaos e2e the acceptance criteria name — a fault-plan-injected dead
+worker and a straggler on a ring-8 CPU run, both detected from heartbeat
+records alone, with ``watch --once`` exiting 1 there and 0 on the
+fault-free control.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from matcha_tpu.elastic import (
+    ElasticController,
+    LiveMembershipSource,
+    MembershipEvent,
+    load_membership_trace,
+)
+from matcha_tpu.obs import read_journal, read_journal_tail, validate_event
+from matcha_tpu.obs.anomaly import AnomalyDetector, liveness, mad_zscores
+from matcha_tpu.obs.health import (
+    HeartbeatEmitter,
+    fleet_status,
+    heartbeat_path,
+    read_heartbeats,
+    render_watch,
+    worker_last_seen,
+)
+from matcha_tpu.train import TrainConfig, train
+
+pytestmark = pytest.mark.health
+
+# the chaos recipe: ring-8 MATCHA, 4 steps/epoch (256 train / 8 workers /
+# bs 8) so a period-4 straggler participates exactly 0.25 of each epoch
+BASE = TrainConfig(
+    name="health", model="mlp", dataset="synthetic",
+    dataset_kwargs={"num_train": 256, "num_test": 32},
+    num_workers=8, graphid=5, batch_size=8, epochs=4, lr=0.05,
+    warmup=False, matcha=True, budget=0.5, seed=3, save=True,
+    eval_every=0, measure_comm_split=False,
+)
+
+# dead w3 over epochs 1-2 (steps 4..12), straggler w5 the whole run
+CHAOS_PLAN = {"events": [
+    {"kind": "dead", "worker": 3, "start": 4, "stop": 12},
+    {"kind": "straggler", "worker": 5, "start": 0, "period": 4},
+]}
+
+
+@pytest.fixture(scope="module")
+def healthy_run(tmp_path_factory):
+    root = tmp_path_factory.mktemp("health_ok")
+    cfg = dataclasses.replace(BASE, name="ok", savePath=str(root))
+    return train(cfg), str(root / "ok_mlp")
+
+
+@pytest.fixture(scope="module")
+def chaos_run(tmp_path_factory):
+    root = tmp_path_factory.mktemp("health_chaos")
+    cfg = dataclasses.replace(BASE, name="chaos", savePath=str(root),
+                              fault_plan=dict(CHAOS_PLAN))
+    return train(cfg), str(root / "chaos_mlp")
+
+
+def _journal(run_dir):
+    return read_journal(os.path.join(run_dir, "events.jsonl"))
+
+
+# ------------------------------------------------------------- pure units
+
+def test_mad_zscores_robust_fallbacks():
+    z = mad_zscores([1.0, 1.0, 1.0, 1.0, 11.0])
+    assert z[-1] > 4.0 and abs(z[0]) < 1e-12
+    # zero MAD (majority identical) falls back to mean absolute deviation
+    # instead of dividing by zero; all-identical yields zeros, not NaN
+    assert np.isfinite(mad_zscores([2.0, 2.0, 2.0, 9.0])).all()
+    assert mad_zscores([5.0] * 6).tolist() == [0.0] * 6
+
+
+def test_liveness_deadline_and_clock_skew():
+    seen = {"host0": 100.0, "host1": 10.0, "host2": 500.0}
+    overdue = liveness(seen, now=130.0, deadline=60.0)
+    assert set(overdue) == {"host1"} and overdue["host1"] == 120.0
+    # a future timestamp (shared-FS clock skew) clamps to age 0: a faster
+    # clock must not kill a live host
+    assert "host2" not in liveness(seen, now=130.0, deadline=60.0)
+    assert liveness({}, now=0.0, deadline=1.0) == {}
+
+
+def _hb(epoch, workers, host="host0", step_time=0.1, comm_time=0.1):
+    return {"host": host, "epoch": epoch, "step": (epoch + 1) * 4,
+            "step_time": step_time, "step_time_ewma": step_time,
+            "comp_time": 0.3, "comm_time": comm_time, "peak_bytes": None,
+            "workers": workers}
+
+
+def _w(participation=1.0, disagreement=0.0, slot=0):
+    return {"slot": slot, "participation": participation,
+            "disagreement": disagreement}
+
+
+def test_detector_participation_verdicts():
+    det = AnomalyDetector()
+    verdicts = det.observe(_hb(2, {
+        "w0": _w(1.0, slot=0), "w1": _w(0.0, slot=1),
+        "w2": _w(0.25, slot=2), "w3": _w(0.95, slot=3)}))
+    by_subject = {a["subject"]: a for a in verdicts}
+    assert by_subject["w1"]["cause"] == "dead"
+    assert by_subject["w2"]["cause"] == "straggler"
+    assert "w0" not in by_subject and "w3" not in by_subject
+    assert all(a["epoch"] == 2 for a in verdicts)
+    with pytest.raises(ValueError, match="dead_below"):
+        AnomalyDetector(dead_below=0.9, straggler_below=0.5)
+    with pytest.raises(ValueError, match="z_threshold"):
+        AnomalyDetector(z_threshold=-1.0)
+
+
+def test_detector_disagreement_outlier_one_sided():
+    det = AnomalyDetector()
+    workers = {f"w{i}": _w(1.0, 0.001, slot=i) for i in range(7)}
+    workers["w7"] = _w(1.0, 0.05, slot=7)
+    [a] = [a for a in det.observe(_hb(1, workers))
+           if a["cause"] == "disagreement_outlier"]
+    assert a["subject"] == "w7" and a["zscore"] > det.z_threshold
+    # one-sided: a worker *closer* to consensus than its peers is fine
+    workers["w7"] = _w(1.0, 0.0, slot=7)
+    assert not det.observe(_hb(2, workers))
+    # under min_history workers: silent (no fleet to be an outlier of)
+    tiny = {f"w{i}": _w(1.0, [0.001, 0.05][i % 2], slot=i) for i in range(2)}
+    assert not AnomalyDetector().observe(_hb(0, tiny))
+
+
+def test_detector_time_spike_scored_against_prior_history():
+    det = AnomalyDetector(min_history=4)
+    for e in range(4):  # build a stable step-time history
+        assert det.observe(_hb(e, {}, step_time=0.1)) == []
+    [a] = det.observe(_hb(4, {}, step_time=1.0))
+    assert a["cause"] == "step_time_spike" and a["subject"] == "host0"
+    assert a["value"] == 1.0 and a["zscore"] > det.z_threshold
+    # the spike joined the history *after* being scored, not before —
+    # and one spike must not make the next normal beat an outlier
+    assert det.observe(_hb(5, {}, step_time=0.1)) == []
+    # comm-time spikes are scored on their own series
+    det2 = AnomalyDetector()
+    for e in range(4):
+        det2.observe(_hb(e, {}, comm_time=0.05))
+    causes = [a["cause"] for a in det2.observe(_hb(4, {}, comm_time=2.0))]
+    assert causes == ["comm_time_spike"]
+
+
+# --------------------------------------------------------------- emitter
+
+def test_heartbeat_emitter_schema_ewma_and_layout(tmp_path):
+    em = HeartbeatEmitter(str(tmp_path / "health"), host="host0",
+                          ewma_alpha=0.5)
+    before = time.time()
+    hb = em.beat(epoch=0, step=4, steps=4.0, epoch_time=0.4, comm_time=0.1,
+                 workers={"w0": _w(1.0, 0.01, slot=0)})
+    assert hb["step_time"] == pytest.approx(0.1)
+    assert hb["step_time_ewma"] == pytest.approx(0.1)  # first beat: = value
+    hb2 = em.beat(epoch=1, step=8, steps=4.0, epoch_time=1.2, comm_time=0.2,
+                  workers={"w0": _w(1.0, 0.01, slot=0)})
+    assert hb2["step_time_ewma"] == pytest.approx(0.5 * 0.3 + 0.5 * 0.1)
+    # the on-disk records are valid v3 journal events with absolute t
+    path = heartbeat_path(str(tmp_path / "health"), "host0")
+    events = read_journal(path)
+    assert len(events) == 2
+    for e in events:
+        assert validate_event(e) == [] and e["v"] == 3
+        assert e["kind"] == "heartbeat" and e["t"] >= before
+    assert events[1]["comp_time"] == pytest.approx(1.0)
+    # comm_time can never exceed the epoch wall (clamped, comp stays >= 0)
+    hb3 = em.beat(epoch=2, step=12, steps=4.0, epoch_time=0.4,
+                  comm_time=9.0, workers={})
+    assert hb3["comm_time"] == 0.4 and hb3["comp_time"] == 0.0
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        HeartbeatEmitter(str(tmp_path), ewma_alpha=0.0)
+
+
+def test_reader_drops_concurrent_partial_append(tmp_path):
+    """ISSUE 10 satellite: a writer appending mid-read must never yield a
+    torn record.  The reverse-tail reader snapshots the file size before
+    reading, and a trailing half-line (a writer caught between write and
+    newline) is dropped, never parsed."""
+    em = HeartbeatEmitter(str(tmp_path), host="host0")
+    for e in range(5):
+        em.beat(epoch=e, step=4 * (e + 1), steps=4.0, epoch_time=0.4,
+                comm_time=0.1, workers={"w0": _w(slot=0)})
+    path = em.path
+    whole = read_journal_tail(path, 10)
+    assert [e["epoch"] for e in whole] == [0, 1, 2, 3, 4]
+
+    # a half-appended record (no newline yet): dropped by both readers
+    with open(path, "a") as f:
+        f.write('{"v": 3, "kind": "heartbeat", "t": 99.0, "host": "ho')
+    assert [e["epoch"] for e in read_journal_tail(path, 10)] == [0, 1, 2, 3, 4]
+    by_host = read_heartbeats(str(tmp_path), tail=10)
+    assert [e["epoch"] for e in by_host["host0"]] == [0, 1, 2, 3, 4]
+
+    # a writer landing *between* the reader's open and its block reads:
+    # the size snapshot bounds the window, so the in-flight append is
+    # invisible this read and whole the next
+    class AppendingMidRead:
+        def __init__(self, f):
+            self._f = f
+            self.fired = False
+
+        def seek(self, *a):
+            return self._f.seek(*a)
+
+        def tell(self):
+            return self._f.tell()
+
+        def read(self, n):
+            if not self.fired:
+                self.fired = True
+                with open(path, "a") as w:
+                    w.write('st0", "epoch": 5, "step": 24, "step_time": 0.1,'
+                            ' "step_time_ewma": 0.1, "comp_time": 0.3,'
+                            ' "comm_time": 0.1, "peak_bytes": null,'
+                            ' "workers": {}}\n')
+            return self._f.read(n)
+
+    from matcha_tpu.obs.journal import _tail_lines
+    with open(path, "rb") as raw:
+        wrapped = AppendingMidRead(raw)
+        lines = _tail_lines(wrapped, 10, block=65536)
+    assert wrapped.fired
+    # only the pre-snapshot partial may fail to parse — and only as the
+    # final fragment (exactly what read_journal_tail drops)
+    mid_read = []
+    for i, ln in enumerate(lines):
+        try:
+            mid_read.append(json.loads(ln))
+        except json.JSONDecodeError:
+            assert i == len(lines) - 1
+    assert [e["epoch"] for e in mid_read] == [0, 1, 2, 3, 4]
+    # ... and the completed line is a whole record on the next read
+    assert [e["epoch"] for e in read_journal_tail(path, 10)] == \
+        [0, 1, 2, 3, 4, 5]
+
+
+# ---------------------------------------------------------- fleet status
+
+def _write_hb(health_dir, host, t, workers, epoch=0):
+    """Handcraft a heartbeat line with a chosen absolute timestamp (the
+    emitter always stamps time.time(); liveness tests need a controlled
+    clock)."""
+    event = {"v": 3, "kind": "heartbeat", "t": float(t), **_hb(
+        epoch, {w: _w(slot=i) for i, w in enumerate(workers)}, host=host)}
+    assert validate_event(event) == []
+    os.makedirs(health_dir, exist_ok=True)
+    with open(heartbeat_path(health_dir, host), "a") as f:
+        f.write(json.dumps(event) + "\n")
+
+
+def test_fleet_status_healthy_then_deadline_missed(tmp_path):
+    hdir = str(tmp_path / "health")
+    _write_hb(hdir, "host0", 1000.0, ["w0", "w1"], epoch=0)
+    _write_hb(hdir, "host1", 1001.0, ["w2", "w3"], epoch=0)
+    status = fleet_status(hdir, now=1030.0, deadline=60.0)
+    assert not status["flagged"] and len(status["rows"]) == 4
+    assert all(r["alive"] for r in status["rows"])
+    text = render_watch(status)
+    assert "verdict: HEALTHY" in text and "w2" in text
+    # host1 goes dark (host0 keeps beating): it and both its workers are
+    # presumed down, host0's stay alive
+    _write_hb(hdir, "host0", 1090.0, ["w0", "w1"], epoch=1)
+    status = fleet_status(hdir, now=1100.0, deadline=60.0)
+    assert status["flagged"]
+    down = {a["subject"] for a in status["anomalies"]}
+    assert {"host1", "w2", "w3"} <= down
+    rows = {r["worker"]: r for r in status["rows"]}
+    assert rows["w0"]["alive"] and not rows["w2"]["alive"]
+    assert "deadline_missed" in rows["w3"]["flags"]
+    text = render_watch(status)
+    assert "ANOMALOUS" in text and "deadline_missed" in text
+    md = render_watch(status, markdown=True)
+    assert md.startswith("# Fleet health") and "| w2 |" in md
+    # last-seen is per *worker* (a worker a host stopped listing keeps
+    # its frozen timestamp)
+    seen = worker_last_seen(read_heartbeats(hdir))
+    assert seen == {"w0": 1090.0, "w1": 1090.0, "w2": 1001.0, "w3": 1001.0}
+    with pytest.raises(FileNotFoundError):
+        fleet_status(str(tmp_path / "nothing"))
+
+
+def test_summary_renders_and_dedupes_replayed_health_events():
+    """ISSUE 10 satellite: crash-resume replays heartbeat/anomaly events
+    into the journal; `summary` must dedupe them per (epoch, host) and
+    (epoch, subject, cause) — keeping the latest — exactly the way
+    `membership` events were fixed in PR 9's second review round, while
+    genuinely distinct events (another host's beat, another worker's
+    verdict) survive."""
+    from matcha_tpu.obs.report import render_summary, summarize
+
+    def hb_event(t, epoch, host, ewma):
+        return {"v": 3, "kind": "heartbeat", "t": t,
+                **_hb(epoch, {}, host=host, step_time=ewma)}
+
+    def anomaly_event(t, epoch, subject, cause, value=0.0):
+        return {"v": 3, "kind": "anomaly", "t": t, "epoch": epoch,
+                "subject": subject, "cause": cause, "value": value,
+                "threshold": 0.05}
+
+    events = [
+        {"v": 1, "kind": "run_start", "t": 0.0, "config": {},
+         "predicted": {}},
+        hb_event(1.0, 0, "host0", 0.5),     # superseded by the replay
+        hb_event(1.1, 0, "host1", 0.1),
+        anomaly_event(1.2, 0, "w3", "dead"),
+        {"v": 1, "kind": "resume", "t": 2.0, "epoch": 0},
+        hb_event(2.1, 0, "host0", 0.1),     # the replayed epoch's copy
+        anomaly_event(2.2, 0, "w3", "dead"),       # replayed: collapses
+        anomaly_event(2.3, 0, "w5", "straggler"),  # distinct: survives
+    ]
+    for e in events:
+        assert validate_event(e) == []
+    digest = summarize(events)
+    assert len(digest["heartbeat"]) == 2  # one per (epoch, host)
+    host0 = [e for e in digest["heartbeat"] if e["host"] == "host0"]
+    assert [e["step_time_ewma"] for e in host0] == [0.1]  # latest won
+    assert len(digest["anomaly"]) == 2
+    assert {(a["subject"], a["cause"]) for a in digest["anomaly"]} == \
+        {("w3", "dead"), ("w5", "straggler")}
+    text = render_summary(events)
+    assert "heartbeats: 2" in text
+    assert text.count("ANOMALY @e0") == 2
+
+
+def test_compare_carries_anomaly_count(healthy_run, chaos_run):
+    """`compare` rows carry the run's anomaly count — a number from an
+    anomalous fleet is not comparable evidence (None for pre-health
+    journals that never heartbeated)."""
+    from matcha_tpu.obs.report import compare_sources, render_compare
+
+    _, ok_dir = healthy_run
+    _, chaos_dir = chaos_run
+    rows, problems = compare_sources([ok_dir, chaos_dir])
+    assert problems == []
+    by_src = {r["source"]: r for r in rows}
+    assert by_src[os.path.basename(ok_dir)]["anomalies"] == 0
+    assert by_src[os.path.basename(chaos_dir)]["anomalies"] > 0
+    table = render_compare(rows, problems)
+    assert "anomalies" in table.splitlines()[0]
+
+
+# ------------------------------------------------- live membership source
+
+class _StubSchedule:
+    alpha = 0.5
+
+    def refold_for(self, alive):
+        return 0.1 * float(np.sum(alive)), 0.9, None
+
+
+def test_live_source_parity_with_declared_trace(tmp_path):
+    """The acceptance pin: the same liveness history drives the controller
+    to the same live-set sequence as the equivalent declared trace."""
+    hdir = str(tmp_path / "health")
+    clock = [10.0]
+    src = LiveMembershipSource(hdir, deadline=30.0, min_live=2,
+                               now_fn=lambda: clock[0])
+    live_ctl = ElasticController(src, 4)
+    declared_ctl = ElasticController(load_membership_trace({"events": [
+        {"kind": "leave", "epoch": 2, "worker": "w3"},
+        {"kind": "rejoin", "epoch": 3, "worker": "w3"},
+    ]}), 4)
+    sched_a, sched_b = _StubSchedule(), _StubSchedule()
+
+    beats = {  # epoch -> (now, workers heartbeating at that boundary)
+        0: (10.0, ["w0", "w1", "w2", "w3"]),
+        1: (20.0, ["w0", "w1", "w2"]),       # w3 silent, age 10 < 30
+        2: (55.0, ["w0", "w1", "w2"]),       # w3 age 45 > 30: leave
+        3: (65.0, ["w0", "w1", "w2", "w3"]),  # w3 back: rejoin
+    }
+    masks_live, masks_declared = [], []
+    for epoch in range(4):
+        now, workers = beats[epoch]
+        clock[0] = now
+        _write_hb(hdir, "host0", now, workers, epoch=epoch)
+        live_ctl.advance(epoch, sched_a)
+        declared_ctl.advance(epoch, sched_b)
+        masks_live.append(live_ctl.alive_mask().tolist())
+        masks_declared.append(declared_ctl.alive_mask().tolist())
+    assert masks_live == masks_declared
+    assert live_ctl.view.occupants == declared_ctl.view.occupants
+    assert live_ctl.alpha == declared_ctl.alpha
+    # the observed churn, replayed as a declared trace, is the same trace
+    observed = src.as_trace()
+    assert [(e.kind, e.epoch, e.worker) for e in observed.events] == \
+        [("leave", 2, "w3"), ("rejoin", 3, "w3")]
+    assert src.horizon() == 3
+
+
+def test_live_source_poll_cache_grace_and_clamps(tmp_path):
+    hdir = str(tmp_path / "health")
+    clock = [100.0]
+    src = LiveMembershipSource(hdir, deadline=10.0, min_live=2,
+                               now_fn=lambda: clock[0])
+    src.start_view(4)
+    _write_hb(hdir, "host0", 100.0, ["w0", "w1", "w2", "w3"])
+    assert src.at_epoch(0) == []
+    # a boundary polls once: re-advancing (rollback retry, resume replay)
+    # replays the cached decision even after the clock moved on
+    clock[0] = 1000.0
+    assert src.at_epoch(0) == []
+    # all four overdue, but leaves clamp at min_live: only 2 leave, in
+    # sorted order — the fleet-wide outage must not dismantle consensus
+    evs = src.at_epoch(1)
+    assert [(e.kind, e.worker) for e in evs] == [("leave", "w0"),
+                                                ("leave", "w1")]
+    # a stale stranger is not an arrival; a fresh one joins (slots free)
+    _write_hb(hdir, "host1", 500.0, ["old_news"])
+    _write_hb(hdir, "host2", 999.0, ["fresh"])
+    evs = src.at_epoch(2)
+    kinds = {(e.kind, e.worker) for e in evs}
+    assert ("join", "fresh") in kinds
+    assert all(e.worker != "old_news" for e in evs)
+    # a member that never heartbeated gets grace from the *first poll*
+    src2 = LiveMembershipSource(str(tmp_path / "empty"), deadline=10.0,
+                                grace=50.0, now_fn=lambda: clock[0])
+    src2.start_view(3)
+    clock[0] = 1040.0
+    assert src2.at_epoch(0) == []   # first poll: grace clock starts here
+    clock[0] = 1080.0
+    evs = src2.at_epoch(1)          # 40s past first poll < 50s grace? no:
+    assert [(e.kind, e.worker) for e in evs] == []  # 40 < 50: still graced
+    clock[0] = 1095.0
+    evs = src2.at_epoch(2)          # 55s > grace: leaves (min_live clamps)
+    assert [(e.kind, e.worker) for e in evs] == [("leave", "w0")]
+    with pytest.raises(ValueError, match="deadline"):
+        LiveMembershipSource(hdir, deadline=0.0)
+    with pytest.raises(ValueError, match="min_live"):
+        LiveMembershipSource(hdir, min_live=1)
+
+
+def test_live_source_seed_replay_overrides_todays_clock(tmp_path):
+    """Resume correctness: the per-epoch poll cache dies with the
+    process, so a resumed run seeds it from the journal's `membership`
+    events (the cache's persisted copy) — otherwise replaying history
+    would re-poll against today's wall clock and a leaver whose host has
+    since recovered would retroactively never have left, diverging from
+    the checkpoint sidecar."""
+    hdir = str(tmp_path / "health")
+    clock = [1000.0]
+    src = LiveMembershipSource(hdir, deadline=30.0,
+                               now_fn=lambda: clock[0])
+    src.start_view(4)
+    # w3's host has recovered: every worker heartbeats fresh TODAY — a
+    # live re-poll of history would never emit the original leave
+    _write_hb(hdir, "host0", 1000.0, ["w0", "w1", "w2", "w3"])
+    journal = [{"v": 2, "kind": "membership", "t": 1.0, "epoch": 1,
+                "old_alive": [1, 1, 1, 1], "new_alive": [1, 1, 1, 0],
+                "trigger": [{"kind": "leave", "epoch": 1, "worker": "w3"}],
+                "alpha": 0.5, "rho": 0.9, "replanned": True}]
+    src.seed_replay(journal, upto_epoch=3)
+    assert src.at_epoch(0) == []
+    assert [(e.kind, e.worker) for e in src.at_epoch(1)] == \
+        [("leave", "w3")]
+    assert src.at_epoch(2) == []  # no record at 2: the poll was empty
+    # the member mirror carried the seed forward: the first LIVE poll
+    # sees w3 as an ever-member with a fresh heartbeat -> rejoin
+    assert [(e.kind, e.worker) for e in src.at_epoch(3)] == \
+        [("rejoin", "w3")]
+
+
+def test_run_journal_is_never_liveness_evidence(tmp_path):
+    """A run dir whose health/ is gone (health off, or deleted) holds
+    only events.jsonl — whose mirrored heartbeats carry the RUN-relative
+    clock.  Reading them as liveness would convict every worker of a
+    ~unix-epoch absence; the resolver must refuse instead."""
+    run_dir = tmp_path / "somerun_mlp"
+    run_dir.mkdir()
+    mirrored = {"v": 3, "kind": "heartbeat", "t": 2.5, **_hb(0, {
+        "w0": _w(slot=0)})}  # t = seconds since run start, NOT unix time
+    (run_dir / "events.jsonl").write_text(json.dumps(mirrored) + "\n")
+    with pytest.raises(FileNotFoundError, match="no health"):
+        fleet_status(str(run_dir))
+    assert read_heartbeats(str(run_dir)) == {}
+    # ... while a real per-host file next to it is still found
+    _write_hb(str(run_dir), "host0", 1000.0, ["w0"])
+    assert list(read_heartbeats(str(run_dir))) == ["host0"]
+
+
+def test_train_with_live_membership_source(tmp_path):
+    """e2e: `membership_live` pointed at a heartbeat directory where w3's
+    newest beat is an hour stale — the first boundary poll turns it into
+    a leave through the existing controller (journaled `membership` event,
+    zero retraces), closing the ROADMAP follow-on end to end."""
+    hdir = str(tmp_path / "fleet_health")
+    now = time.time()
+    _write_hb(hdir, "host0", now - 3600.0,
+              [f"w{i}" for i in range(8)], epoch=0)
+    _write_hb(hdir, "host0", now, [f"w{i}" for i in range(8) if i != 3],
+              epoch=1)
+    cfg = dataclasses.replace(
+        BASE, name="live", savePath=str(tmp_path), epochs=2,
+        dataset_kwargs={"num_train": 128, "num_test": 32},
+        membership_live=hdir, membership_deadline=60.0)
+    result = train(cfg)
+    events = _journal(str(tmp_path / "live_mlp"))
+    members = [e for e in events if e["kind"] == "membership"]
+    assert len(members) == 1 and members[0]["epoch"] == 0
+    assert [t["kind"] for t in members[0]["trigger"]] == ["leave"]
+    assert [t["worker"] for t in members[0]["trigger"]] == ["w3"]
+    assert (sum(members[0]["old_alive"]),
+            sum(members[0]["new_alive"])) == (8.0, 7.0)
+    assert not [e for e in events if e["kind"] == "retrace"]
+    # the run's own heartbeats list only the 7 remaining members
+    hb = [e for e in events if e["kind"] == "heartbeat"]
+    assert hb and all(len(e["workers"]) == 7 for e in hb)
+    assert all("w3" not in e["workers"] for e in hb)
+    assert len(result.history) == 2
+
+
+def test_config_live_membership_validation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        dataclasses.replace(BASE, membership_live="x",
+                            membership_trace={"events": []})
+    with pytest.raises(ValueError, match="membership_deadline"):
+        dataclasses.replace(BASE, membership_deadline=0.0)
+    with pytest.raises(ValueError, match="communicator"):
+        dataclasses.replace(BASE, communicator="none", membership_live="x")
+
+
+# ------------------------------------------------------------- chaos e2e
+
+def test_chaos_detected_from_heartbeat_records_alone(chaos_run):
+    """The acceptance run: the dead worker and the straggler are both
+    convicted by detectors reading ONLY the heartbeat files — and the run
+    journal carries the same verdicts as `anomaly` events naming the
+    worker and the cause."""
+    _, run_dir = chaos_run
+    # (a) journaled by the train loop's streaming detectors
+    anomalies = [e for e in _journal(run_dir) if e["kind"] == "anomaly"]
+    convicted = {(a["subject"], a["cause"]) for a in anomalies}
+    assert ("w3", "dead") in convicted
+    assert ("w5", "straggler") in convicted
+    for a in anomalies:
+        assert validate_event(a) == [] and a["v"] == 3
+    dead = [a for a in anomalies if a["cause"] == "dead"]
+    assert {a["epoch"] for a in dead} == {1, 2}  # exactly the dead window
+    assert all(a["value"] <= a["threshold"] for a in dead)
+    straggler = [a for a in anomalies if (a["subject"], a["cause"])
+                 == ("w5", "straggler")]
+    # period-4 straggler over 4-step epochs: participation pinned at 1/4
+    assert all(a["value"] == pytest.approx(0.25) for a in straggler)
+    # (b) re-derived from the heartbeat files alone (the health dir IS
+    # the interface — no journal, no TrainResult; huge deadline so the
+    # wall-clock gap between fixture and test can't add liveness flags)
+    status = fleet_status(os.path.join(run_dir, "health"),
+                          deadline=86400.0)
+    flags = {(a["subject"], a["cause"]) for a in status["anomalies"]}
+    assert ("w3", "dead") in flags and ("w5", "straggler") in flags
+    rows = {r["worker"]: r for r in status["rows"]}
+    assert not rows["w3"]["alive"] and rows["w5"]["participation"] == 0.25
+    # (c) zero jit-cache growth under the existing retrace watch
+    assert not [e for e in _journal(run_dir) if e["kind"] == "retrace"]
+
+
+def test_healthy_run_heartbeats_and_no_anomalies(healthy_run):
+    _, run_dir = healthy_run
+    events = _journal(run_dir)
+    hb = [e for e in events if e["kind"] == "heartbeat"]
+    assert len(hb) == BASE.epochs
+    for e in hb:
+        assert validate_event(e) == []
+        assert set(e["workers"]) == {f"w{i}" for i in range(8)}
+        assert all(w["participation"] == pytest.approx(1.0)
+                   for w in e["workers"].values())
+    assert [e for e in events if e["kind"] == "anomaly"] == []
+    assert not fleet_status(os.path.join(run_dir, "health"),
+                            deadline=86400.0)["flagged"]
+
+
+def test_watch_once_exit_codes(chaos_run, healthy_run, tmp_path, capsys):
+    """`watch --once` exits 1 on the chaos run, 0 on the fault-free run,
+    2 when no heartbeats exist — the CI-gate contract."""
+    import obs_tpu
+
+    _, chaos_dir = chaos_run
+    _, ok_dir = healthy_run
+    md = tmp_path / "health.md"
+    # huge --deadline: the verdict must come from the heartbeat *records*
+    # (dead/straggler), not from how long ago the fixture happened to run
+    assert obs_tpu.main(["watch", chaos_dir, "--once",
+                         "--deadline", "86400", "--md", str(md)]) == 1
+    out = capsys.readouterr().out
+    assert "verdict: ANOMALOUS" in out and "straggler" in out
+    assert md.read_text().startswith("# Fleet health")
+    assert obs_tpu.main(["watch", ok_dir, "--once",
+                         "--deadline", "86400"]) == 0
+    assert "verdict: HEALTHY" in capsys.readouterr().out
+    # the `health` alias is the same command
+    assert obs_tpu.main(["health", ok_dir, "--once",
+                         "--deadline", "86400"]) == 0
+    assert obs_tpu.main(["watch", str(tmp_path / "void"), "--once"]) == 2
+
+
+# ------------------------------------------- zero-new-device-syncs pin
+
+def test_health_plane_is_pure_host_code():
+    """The detectors and the emitter never touch jax: the one sanctioned
+    device read stays the telemetry flush (counted below)."""
+    import matcha_tpu.obs.anomaly as anomaly
+    import matcha_tpu.obs.health as health
+
+    for mod in (anomaly, health):
+        src = open(mod.__file__).read()
+        assert "import jax" not in src, f"{mod.__name__} imports jax"
+
+
+def test_telemetry_host_read_count_unchanged_by_health(tmp_path,
+                                                       monkeypatch):
+    """The acceptance pin: heartbeats ride the existing per-epoch flush —
+    enabling the health plane adds zero host reads of device state."""
+    import matcha_tpu.train.loop as loop_mod
+
+    real_flush = loop_mod.telemetry_flush
+    counts = {"on": 0, "off": 0}
+
+    def make_counting_flush(key):
+        def counting_flush(tel):
+            counts[key] += 1
+            return real_flush(tel)
+        return counting_flush
+
+    small = dict(dataset_kwargs={"num_train": 64, "num_test": 32},
+                 epochs=2)
+    for key, health_on in (("on", True), ("off", False)):
+        monkeypatch.setattr(loop_mod, "telemetry_flush",
+                            make_counting_flush(key))
+        cfg = dataclasses.replace(
+            BASE, name=f"flush_{key}", health=health_on,
+            savePath=str(tmp_path), **small)
+        train(cfg)
+    assert counts["on"] == counts["off"] == 2  # one per epoch, either way

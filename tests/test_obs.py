@@ -170,15 +170,19 @@ def test_reference_journal_validates_line_by_line():
     for the scanned-epoch program, populated on this CPU backend.  ISSUE 9
     re-pins with the elastic `membership` kind: the reference recipe churns
     w3 (leave @2, rejoin @5), so both transitions — and their re-derived
-    α/ρ — are committed evidence, not just vocabulary."""
+    α/ρ — are committed evidence, not just vocabulary.  ISSUE 10 re-pins
+    at v3 with the live health plane: the recipe gained a period-4
+    fault-plan straggler on w5 (4-step epochs ⇒ participation exactly
+    0.25), so the journal commits one `heartbeat` per epoch and the
+    streaming detector's `straggler` `anomaly` verdicts naming w5."""
     events = read_journal(str(REPO / "benchmarks" / "events_ring8.jsonl"))
     assert events, "reference journal is empty"
     for i, e in enumerate(events):
         assert validate_event(e) == [], f"line {i + 1}: {validate_event(e)}"
-    assert {e["v"] for e in events} == {2}
+    assert {e["v"] for e in events} == {3}
     kinds = {e["kind"] for e in events}
     assert {"run_start", "epoch", "telemetry", "compile",
-            "membership"} <= kinds
+            "membership", "heartbeat", "anomaly"} <= kinds
     leave, rejoin = [e for e in events if e["kind"] == "membership"]
     assert (leave["epoch"], rejoin["epoch"]) == (2, 5)
     assert [t["kind"] for t in leave["trigger"]] == ["leave"]
@@ -194,8 +198,27 @@ def test_reference_journal_validates_line_by_line():
     assert leave["rho"] > rejoin["rho"]
     assert leave["alpha_scale"] != pytest.approx(1.0)
     assert rejoin["alpha_scale"] == pytest.approx(1.0)
-    start = events[0]
-    assert start["kind"] == "run_start"
+    # v3 health plane: one heartbeat per epoch, member slots only (w3's
+    # vacancy window drops it from the roster), and the straggler's
+    # participation — 1 step in 4 — is committed as exactly 0.25
+    heartbeats = [e for e in events if e["kind"] == "heartbeat"]
+    assert [e["epoch"] for e in heartbeats] == list(range(8))
+    assert all(e["host"] == "host0" for e in heartbeats)
+    assert sorted(heartbeats[0]["workers"]) == [f"w{i}" for i in range(8)]
+    assert all("w3" not in e["workers"] for e in heartbeats[2:5])
+    assert "w3" in heartbeats[5]["workers"]
+    for e in heartbeats:
+        assert e["workers"]["w5"]["participation"] == pytest.approx(0.25)
+        assert e["step_time"] > 0 and e["step_time_ewma"] > 0
+        assert e["comp_time"] >= 0 and e["comm_time"] >= 0
+    stragglers = [e for e in events if e["kind"] == "anomaly"
+                  and e["cause"] == "straggler"]
+    assert [e["subject"] for e in stragglers] == ["w5"] * 8
+    assert all(e["value"] == pytest.approx(0.25)
+               and e["value"] < e["threshold"] for e in stragglers)
+    # the fault-plan declaration (`plan`) now precedes run_start: the
+    # recorder journals the compiled fault horizon before the run record
+    [start] = [e for e in events if e["kind"] == "run_start"]
     assert 0.0 < start["predicted"]["rho"] < 1.0
     assert start["predicted"]["steps_per_epoch"] == 4
     [compile_e] = [e for e in events if e["kind"] == "compile"]
@@ -250,6 +273,43 @@ def test_v1_events_validate_verbatim_and_v2_kinds_are_versioned():
              "hbm_bytes": 1.0, "peak_bytes": 1.0}
     assert any("v2 kind" in p for p in validate_event(lying))
     assert validate_event({**lying, "v": 2}) == []
+
+
+def test_v3_kinds_are_versioned_and_v2_events_validate_verbatim():
+    """The v2→v3 bump (ISSUE 10) is additive the same way: every v2
+    event validates verbatim under the v3 reader, and a `heartbeat` /
+    `anomaly` event claiming v<=2 is a lying envelope."""
+    from matcha_tpu.obs.journal import EVENT_KINDS, V3_KINDS
+
+    assert V3_KINDS == {"heartbeat", "anomaly"}
+    assert V3_KINDS <= EVENT_KINDS
+    hb = {"v": 3, "kind": "heartbeat", "t": 1.0, "host": "host0",
+          "epoch": 0, "step": 4, "step_time": 0.1, "step_time_ewma": 0.1,
+          "comp_time": 0.3, "comm_time": 0.1, "peak_bytes": None,
+          "workers": {"w0": {"slot": 0, "participation": 1.0,
+                             "disagreement": 0.01}}}
+    anomaly = {"v": 3, "kind": "anomaly", "t": 1.0, "epoch": 0,
+               "subject": "w5", "cause": "straggler", "value": 0.25,
+               "threshold": 0.9}
+    for event in (hb, anomaly):
+        assert validate_event(event) == []
+        assert any("v3 kind" in p
+                   for p in validate_event({**event, "v": 2}))
+        assert any("v3 kind" in p
+                   for p in validate_event({**event, "v": 1}))
+        assert any("missing" in p for p in validate_event(
+            {k: v for k, v in event.items() if k != "epoch"}))
+    # pre-bump events are untouched: a v2 membership/compile event and a
+    # v1 epoch event all still validate verbatim under the v3 reader
+    v2 = {"v": 2, "kind": "compile", "t": 0.0, "label": "x",
+          "fingerprint": "f", "compile_seconds": 0.1, "flops": 1.0,
+          "hbm_bytes": 1.0, "peak_bytes": 1.0}
+    assert validate_event(v2) == []
+    # a corrupt sub-v1 envelope on a kind with no pinned minimum must
+    # report problems, not KeyError out of the reader
+    problems = validate_event({"v": 0, "kind": "epoch", "t": 1.0})
+    assert any("v1 kind" in p for p in problems)
+    assert any("v=0" in p for p in problems)
 
 
 def test_read_journal_tail_is_bounded_and_exact(tmp_path):

@@ -153,6 +153,25 @@ def parse_args(argv=None) -> TrainConfig:
                         "(re)entering worker from the continuing members' "
                         "average; 'restore' lets a rejoiner keep its own "
                         "quarantined rows when still finite")
+    p.add_argument("--membership-live", default=None,
+                   dest="membership_live",
+                   help="heartbeat directory to drive membership from "
+                        "LIVE instead of a declared trace (a run's "
+                        "health/ dir on a shared FS): a member missing "
+                        "its --membership-deadline leaves, a reappearing "
+                        "worker rejoins — same controller, hysteresis, "
+                        "and re-folds as --membership-trace "
+                        "(DESIGN.md §17); mutually exclusive with it")
+    p.add_argument("--membership-deadline", type=float, default=60.0,
+                   dest="membership_deadline",
+                   help="seconds without a heartbeat before a member is "
+                        "presumed gone (with --membership-live)")
+    p.add_argument("--no-health", action="store_true",
+                   help="disable the live health plane (per-epoch "
+                        "heartbeat records under {run}/health/ and the "
+                        "streaming anomaly detectors — DESIGN.md §17); "
+                        "heartbeats ride --save + telemetry and are pure "
+                        "host work, so this exists for A/B, not speed")
     p.add_argument("--max-recoveries", type=int, default=0,
                    dest="max_recoveries",
                    help="on a non-finite epoch: roll back to the last good "
@@ -240,7 +259,10 @@ def parse_args(argv=None) -> TrainConfig:
         membership_trace=args.membership_trace,
         membership_hysteresis=args.membership_hysteresis,
         membership_bootstrap=args.membership_bootstrap,
+        membership_live=args.membership_live,
+        membership_deadline=args.membership_deadline,
         telemetry=not args.no_telemetry,
+        health=not args.no_health,
         drift_tolerance=args.drift_tolerance,
         drift_patience=args.drift_patience,
         sync_init=not args.no_sync_init,
