@@ -18,13 +18,25 @@ also carries a fault-plan straggler (w5, period 4 over the 4-step epochs
 evidence to commit: one ``heartbeat`` per epoch and the streaming
 detector's ``straggler`` ``anomaly`` verdicts naming w5.
 
+The v4 ``attribution`` kind is pinned by a **planted heterogeneous-link
+scenario**: the CPU run records no real comm split (``comm_time`` is 0),
+so the estimator is fed a synthetic per-epoch comm series
+``y = base + A·θ`` built from the run's own reconstructed activation
+design matrix with θ = ``PLANTED_MATCHING_SECONDS`` (matching 1 priced
+3× matching 0 — the link heterogeneity MATCHA exists to exploit).
+Everything is seed-deterministic, so the journaled estimate recovers θ
+up to the ridge bias, and the companion artifact
+``benchmarks/measured_link_costs_ring8.json`` pins the PL009–011 surface.
+
 Regenerate after a journal schema bump (the v1→v2 bump of ISSUE 8 added
 ``compile`` events from the cost ledger; ISSUE 9 added ``membership``;
-the v2→v3 bump of ISSUE 10 added ``heartbeat`` and ``anomaly``):
+the v2→v3 bump of ISSUE 10 added ``heartbeat`` and ``anomaly``; the
+v3→v4 bump of ISSUE 11 added ``attribution``):
 
     JAX_PLATFORMS=cpu python benchmarks/make_reference_journal.py
 """
 
+import json
 import os
 import shutil
 import sys
@@ -32,6 +44,11 @@ import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+#: the planted per-matching seconds-per-activation (θ) and per-epoch base —
+#: the "heterogeneous links" the committed attribution event must recover
+PLANTED_MATCHING_SECONDS = [0.02, 0.06]
+PLANTED_BASE_SECONDS = 0.01
 
 
 def main() -> int:
@@ -65,7 +82,48 @@ def main() -> int:
     src = os.path.join(root, "runs", "ring8_mlp", "events.jsonl")
     dst = os.path.join(REPO, "benchmarks", "events_ring8.jsonl")
     shutil.copyfile(src, dst)
+
+    # v4 pin: attribute the planted heterogeneous-link scenario and append
+    # the resulting `attribution` event (the schema evidence) plus the
+    # companion measured_link_costs artifact (the planlint PL009-011 pin)
+    import numpy as np
+
+    from matcha_tpu.analysis import lint_link_costs_data
+    from matcha_tpu.obs import append_journal_record, read_journal
+    from matcha_tpu.obs.attribution import (
+        attribute_run,
+        attribution_event_fields,
+        design_matrix,
+        link_costs_artifact,
+        reconstruct_schedule_arrays,
+    )
+
+    events = read_journal(dst)
+    start = next(e for e in events if e["kind"] == "run_start")
+    spe = int(start["predicted"]["steps_per_epoch"])
+    epochs = sorted(e["epoch"] for e in events if e["kind"] == "epoch")
+    flags, _, _, _ = reconstruct_schedule_arrays(
+        start["config"], (max(epochs) + 1) * spe + 1)
+    A = design_matrix(flags, spe, epochs)
+    y = PLANTED_BASE_SECONDS + A @ np.asarray(PLANTED_MATCHING_SECONDS)
+    report = attribute_run(events, comm_seconds=y,
+                           source="planted:ring8-hetero")
+    assert all(report["identifiable"]), report["reason"]
+    recovered = np.asarray(report["per_matching_seconds"])
+    assert np.allclose(recovered, PLANTED_MATCHING_SECONDS, atol=1e-4), \
+        f"planted {PLANTED_MATCHING_SECONDS} vs recovered {recovered}"
+    append_journal_record(dst, "attribution",
+                          **attribution_event_fields(report))
+    costs_path = os.path.join(REPO, "benchmarks",
+                              "measured_link_costs_ring8.json")
+    artifact = link_costs_artifact(report)
+    violations = lint_link_costs_data(artifact, costs_path)
+    assert not violations, violations
+    with open(costs_path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
     print(f"reference journal regenerated: {dst}")
+    print(f"reference link costs regenerated: {costs_path}")
     return 0
 
 

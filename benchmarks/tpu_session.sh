@@ -118,6 +118,31 @@ timeout -k 10 120 python obs_tpu.py watch benchmarks/health_run_r6/health_r6_mlp
     || echo "health_r6: fleet flagged or no heartbeats (see table/stderr)"
 rm -rf benchmarks/health_run_r6
 
+# 1.8 attrib_r7 + timeline_r7 (ISSUE 11: the attribution plane's first
+#     on-TPU evidence).  One saved run WITH the comm split on (the
+#     two-program timer is exactly the per-epoch comm signal the estimator
+#     regresses; more epochs than matchings so the design is identifiable),
+#     then: attribute -> the measured per-matching seconds artifact +
+#     markdown (exit 1 = honestly unidentifiable, itself worth recording),
+#     and timeline -> the scrub-in-Perfetto trace of the same run.  On
+#     real ICI this is the first measured per-link heterogeneity number —
+#     the input the reactive planner (ROADMAP health->plan loop) consumes.
+rm -rf benchmarks/attrib_run_r7
+timeout -k 30 600 python train_tpu.py --name attrib_r7 \
+    --model mlp --dataset synthetic --graphid 2 --numworkers 16 \
+    --epoch 8 --backend auto \
+    --save --savePath benchmarks/attrib_run_r7 > /dev/null
+timeout -k 10 180 python obs_tpu.py attribute \
+    benchmarks/attrib_run_r7/attrib_r7_mlp \
+    --out benchmarks/measured_link_costs_r7.json \
+    --md benchmarks/attrib_r7.md \
+    || echo "attrib_r7: unidentifiable or unusable journal (see stderr)"
+timeout -k 10 180 python obs_tpu.py timeline \
+    benchmarks/attrib_run_r7/attrib_r7_mlp \
+    --out benchmarks/timeline_r7.json \
+    || echo "timeline_r7: trace validation failed (see stderr)"
+rm -rf benchmarks/attrib_run_r7
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist
@@ -179,9 +204,12 @@ timeout -k 30 1200 python benchmarks/budget_sweep.py --reps 2
 # 5. refresh the skip microbench (masked-control discipline)
 timeout -k 30 600 python benchmarks/skip_microbench.py
 
-# 6. obs stamp render: one table across this round's journal and every
-#    committed BENCH_r* record — the cross-round comparison obs_tpu.py
-#    compare exists for, persisted as a committable markdown artifact.
+# 6. obs stamp render: one table across this round's journal, every
+#    committed BENCH_r* record, and the measured link-costs artifacts
+#    (committed reference + this round's capture when step 1.8 landed one)
+#    — the cross-round comparison obs_tpu.py compare exists for, persisted
+#    as a committable markdown artifact.
 timeout -k 10 120 python obs_tpu.py compare "$OBS_JOURNAL" BENCH_r0*.json \
+    benchmarks/measured_link_costs*.json \
     --md benchmarks/obs_compare_r6.md \
     || echo "obs compare: no comparable records (journal missing?)"

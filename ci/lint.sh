@@ -29,6 +29,15 @@
 #                    run — must emit a real per-worker table and exit 0
 #                    on a healthy run (exit 1 is the flagged-fleet CI
 #                    gate; a false positive here would poison it)
+#  11. attribution lane  link-level attribution plane (per-matching cost
+#                    estimator, link-costs artifact, timeline export,
+#                    critical path), as pytest (marker: attribution)
+#  12. attribution smoke  obs_tpu.py timeline must validate + round-trip
+#                    the committed reference journal, and obs_tpu.py
+#                    attribute must exit NON-zero on it (its real comm
+#                    series is all-zero — an unidentifiable run failing
+#                    loudly is the contract; exit 0 would mean noise was
+#                    laundered into measured fact)
 #
 # Fast pre-commit variant: lint only what changed vs a ref —
 #
@@ -113,5 +122,24 @@ for w in w0 w1 w2 w3; do
 done
 grep -q 'verdict: HEALTHY' <<<"$WATCH_OUT" || rc=1
 rm -rf "$HEALTH_DIR"
+
+echo "== attribution pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m attribution -p no:cacheprovider || rc=1
+
+echo "== attribution + timeline smoke (committed reference journal) =="
+TRACE_OUT="$(mktemp)"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python obs_tpu.py timeline \
+    benchmarks/events_ring8.jsonl --out "$TRACE_OUT" >/dev/null || rc=1
+grep -q 'traceEvents' "$TRACE_OUT" || rc=1
+rm -f "$TRACE_OUT"
+# the reference journal's REAL comm series is all-zero (CPU run,
+# measure_comm_split off): attribute must exit non-zero — an
+# unidentifiable run that exits 0 has laundered noise into fact
+if JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python obs_tpu.py attribute \
+    benchmarks/events_ring8.jsonl >/dev/null 2>&1; then
+    echo "attribute smoke: expected a non-zero exit on an unidentifiable run"
+    rc=1
+fi
 
 exit $rc

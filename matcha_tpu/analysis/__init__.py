@@ -34,6 +34,7 @@ from .engine import (
 from .planlint import (
     PLAN_CHECKS,
     discover_plan_files,
+    lint_link_costs_data,
     lint_plan_data,
     lint_plan_file,
     lint_plan_paths,
@@ -55,6 +56,7 @@ __all__ = [
     "check_single_trace",
     "collect_sources",
     "discover_plan_files",
+    "lint_link_costs_data",
     "lint_paths",
     "lint_plan_data",
     "lint_plan_file",

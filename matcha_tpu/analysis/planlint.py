@@ -36,6 +36,25 @@ PL008    chosen is a genuine candidate and ranks first under the
          documented (score, budget) order
 =======  ==================================================================
 
+The ``measured_link_costs.json`` family (``matcha_tpu.link_costs/1``,
+written by ``obs_tpu.py attribute`` — the attribution plane's measured
+per-matching/per-link seconds) verifies under its own rules:
+
+=======  ==================================================================
+PL009    link-costs artifact structure (format tag, schedule block,
+         per-matching table shape)
+PL010    costs sane and anchored to the plan: the schedule's topology
+         regenerates to the stored matching count, matching ids are exactly
+         0..M−1, identifiable seconds and the base are finite and
+         non-negative, and every per-link row is a real edge of its
+         matching with the link shares summing back to the matching's
+         seconds
+PL011    identifiability honest: unidentifiable matchings carry null
+         seconds (never numbers), identifiable ones carry finite
+         non-negative stderr/ci95, and no committed CI may be ≥100× the
+         estimate + base — noise presented as fact
+=======  ==================================================================
+
 Tolerances are 1e-6 absolute unless a check says otherwise — tight enough
 to catch a hand-edited digit, loose enough for cross-platform float noise.
 
@@ -57,6 +76,7 @@ from .engine import Violation
 __all__ = [
     "PLAN_CHECKS",
     "discover_plan_files",
+    "lint_link_costs_data",
     "lint_plan_data",
     "lint_plan_file",
     "lint_plan_paths",
@@ -72,6 +92,9 @@ PLAN_CHECKS = {
     "PL006": "stored rho/steps/comm-fraction re-derive from (L, p, alpha)",
     "PL007": "activation probabilities feasible for the stored budget",
     "PL008": "chosen is a candidate and ranks first by (score, budget)",
+    "PL009": "link-costs artifact structure (format, schedule, tables)",
+    "PL010": "link costs non-negative and anchored to the regenerated plan",
+    "PL011": "identifiability honest (null when unidentifiable, sane CIs)",
 }
 
 _TOL = 1e-6
@@ -279,6 +302,140 @@ def lint_plan_data(data: dict, path: str) -> List[Violation]:
     return out
 
 
+def lint_link_costs_data(data: dict, path: str) -> List[Violation]:
+    """Verify one parsed ``measured_link_costs.json`` artifact (PL009–011).
+
+    Like the PL002 family, everything is re-derived from first principles:
+    the schedule block resolves through the same topology builders the
+    attribution estimator (and training) use, so a tampered matching table
+    cannot hide behind a stale decomposition.
+    """
+    from ..obs.attribution import LINK_COSTS_FORMAT
+    from ..plan.autotune import resolve_topology
+
+    # ---- PL009: structure -------------------------------------------------
+    if data.get("format") != LINK_COSTS_FORMAT:
+        return [_v("PL009", path, f"format {data.get('format')!r} is not "
+                                  f"{LINK_COSTS_FORMAT!r}")]
+    missing = sorted({"schedule", "per_matching", "per_link",
+                      "base_seconds", "epochs_used"} - set(data))
+    if missing:
+        return [_v("PL009", path, f"missing keys {missing}")]
+    per = data["per_matching"]
+    if not isinstance(per, list) or not per:
+        return [_v("PL009", path, "per_matching is not a non-empty list")]
+    if not all(isinstance(r, dict) for r in per):
+        return [_v("PL009", path, "per_matching rows are not objects")]
+    row_missing = sorted({"matching", "seconds", "identifiable", "ci95"}
+                         - set(per[0]))
+    if row_missing:
+        return [_v("PL009", path,
+                   f"per_matching rows missing {row_missing}")]
+    links = data["per_link"]
+    if not isinstance(links, list) \
+            or not all(isinstance(l, dict) for l in links):
+        return [_v("PL009", path, "per_link is not a list of objects")]
+
+    out: List[Violation] = []
+    # ---- PL010: anchored to the regenerated plan, costs sane --------------
+    sched = dict(data.get("schedule", {}))
+    try:
+        decomposed, size, _ = resolve_topology(sched,
+                                               int(sched.get("seed", 0)))
+    except Exception as e:
+        return out + [_v("PL010", path,
+                         f"schedule spec does not resolve: {e}")]
+    M = len(decomposed)
+    ids = [r.get("matching") for r in per]
+    if ids != list(range(M)):
+        out.append(_v("PL010", path,
+                      f"matching ids {ids[:8]}{'…' if len(ids) > 8 else ''} "
+                      f"are not 0..{M - 1} of the regenerated plan "
+                      f"({M} matchings)"))
+        return out  # everything below indexes matchings by id
+    base = data.get("base_seconds")
+    if not isinstance(base, (int, float)) or not math.isfinite(base) \
+            or base < -_TOL:
+        out.append(_v("PL010", path,
+                      f"base_seconds {base!r} is not finite non-negative"))
+    for r in per:
+        s = r.get("seconds")
+        if r.get("identifiable"):
+            if not isinstance(s, (int, float)) or not math.isfinite(s) \
+                    or s < -_TOL:
+                out.append(_v("PL010", path,
+                              f"matching {r['matching']}: identifiable "
+                              f"seconds {s!r} not finite non-negative"))
+    edge_sets = [{tuple(sorted((int(u), int(v)))) for (u, v) in m}
+                 for m in decomposed]
+    link_sum: dict = {}
+    for i, link in enumerate(links):
+        j = link.get("matching", -1)
+        if not isinstance(j, int) or not 0 <= j < M:
+            out.append(_v("PL010", path,
+                          f"per_link[{i}]: matching {j!r} out of range"))
+            continue
+        u, v = link.get("u", -1), link.get("v", -1)
+        if not (isinstance(u, int) and isinstance(v, int)):
+            out.append(_v("PL010", path,
+                          f"per_link[{i}]: edge endpoints "
+                          f"({u!r}, {v!r}) are not worker indices"))
+            continue
+        e = tuple(sorted((u, v)))
+        if e not in edge_sets[j]:
+            out.append(_v("PL010", path,
+                          f"per_link[{i}]: edge {e} is not an edge of "
+                          f"matching {j} in the regenerated decomposition"))
+        s = link.get("seconds")
+        if s is not None:
+            if isinstance(s, (int, float)) and math.isfinite(s):
+                link_sum[j] = link_sum.get(j, 0.0) + float(s)
+            else:
+                out.append(_v("PL010", path,
+                              f"per_link[{i}]: seconds {s!r} is not a "
+                              f"finite number"))
+    for r in per:
+        j, s = int(r["matching"]), r.get("seconds")
+        if r.get("identifiable") and isinstance(s, (int, float)) \
+                and abs(link_sum.get(j, 0.0) - float(s)) > max(
+                    _TOL, 1e-6 * abs(float(s))):
+            out.append(_v("PL010", path,
+                          f"matching {j}: per-link shares sum to "
+                          f"{link_sum.get(j, 0.0):.9g}, not the matching's "
+                          f"{float(s):.9g} — the decomposition leaks cost"))
+
+    # ---- PL011: identifiability honest ------------------------------------
+    base_mag = abs(float(base)) if isinstance(base, (int, float)) else 0.0
+    for r in per:
+        j = r["matching"]
+        if not r.get("identifiable"):
+            if r.get("seconds") is not None:
+                out.append(_v("PL011", path,
+                              f"matching {j}: unidentifiable but carries "
+                              f"seconds {r['seconds']!r} — noise committed "
+                              f"as fact"))
+            continue
+        for key in ("stderr", "ci95"):
+            v = r.get(key)
+            if v is None:
+                continue
+            if not isinstance(v, (int, float)) or not math.isfinite(v) \
+                    or v < 0:
+                out.append(_v("PL011", path,
+                              f"matching {j}: {key} {v!r} not finite "
+                              f"non-negative"))
+        ci = r.get("ci95")
+        s = r.get("seconds")
+        if isinstance(ci, (int, float)) and isinstance(s, (int, float)) \
+                and math.isfinite(ci) \
+                and ci >= 100.0 * (abs(float(s)) + base_mag + 1e-9):
+            out.append(_v("PL011", path,
+                          f"matching {j}: ci95 {ci:.3g} is >=100x the "
+                          f"estimate+base ({abs(float(s)) + base_mag:.3g}) "
+                          f"— mark it unidentifiable instead"))
+    return out
+
+
 def _is_planish(data) -> bool:
     """Any version of the plan format family — a *drifted or tampered*
     version tag must surface as PL001, not vanish from the scan."""
@@ -286,14 +443,28 @@ def _is_planish(data) -> bool:
         and str(data.get("format", "")).startswith("matcha_tpu.plan")
 
 
+def _is_link_costs(data) -> bool:
+    """Any version of the link-costs family — same drifted-tag rule."""
+    return isinstance(data, dict) \
+        and str(data.get("format", "")).startswith("matcha_tpu.link_costs")
+
+
 def lint_plan_file(path: str | pathlib.Path) -> Tuple[List[Violation], bool]:
     """``(violations, is_plan)``; ``is_plan`` False when the file is not a
-    plan artifact at all (other benchmark JSONs live alongside them)."""
+    plan-family artifact at all (other benchmark JSONs live alongside
+    them).  Link-costs artifacts route to their own PL009–011 checks."""
     p = pathlib.Path(path)
     try:
         data = json.loads(p.read_text())
     except (OSError, json.JSONDecodeError) as e:
         return [_v("PL001", str(p), f"unreadable: {e}")], True
+    if _is_link_costs(data):
+        try:
+            return lint_link_costs_data(data, str(p)), True
+        except Exception as e:  # tampered structure must be a verdict,
+            # never a traceback that aborts the whole directory scan
+            return [_v("PL009", str(p),
+                       f"artifact malformed: {type(e).__name__}: {e}")], True
     if not _is_planish(data):
         return [], False
     return lint_plan_data(data, str(p)), True
@@ -303,9 +474,10 @@ def discover_plan_files(paths: Sequence[str | pathlib.Path]
                         ) -> List[pathlib.Path]:
     """Expand files/directories into the plan artifacts they contain
     (directories scan ``*.json`` non-recursively — benchmark directories
-    hold flat artifact sets).  Matches the whole ``matcha_tpu.plan`` format
-    family, so an artifact with a wrong *version* tag is still scanned (and
-    then fails PL001) instead of silently dropping out."""
+    hold flat artifact sets).  Matches the whole ``matcha_tpu.plan`` *and*
+    ``matcha_tpu.link_costs`` format families, so an artifact with a wrong
+    *version* tag is still scanned (and then fails PL001/PL009) instead of
+    silently dropping out."""
     out: List[pathlib.Path] = []
     for p in paths:
         p = pathlib.Path(p)
@@ -315,7 +487,7 @@ def discover_plan_files(paths: Sequence[str | pathlib.Path]
                 data = json.loads(f.read_text())
             except (OSError, json.JSONDecodeError):
                 continue
-            if _is_planish(data):
+            if _is_planish(data) or _is_link_costs(data):
                 out.append(f)
     return out
 

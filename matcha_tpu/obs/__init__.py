@@ -36,9 +36,21 @@ And the *live* half (DESIGN.md §17, ISSUE 10):
   deadline-missed), journaled as v3 ``anomaly`` events with an
   attributed cause.
 
+And the *attribution plane* (DESIGN.md §18, ISSUE 11):
+
+* :mod:`attribution` — measured per-matching/per-link costs: the flag
+  stream regenerated from the journaled schedule seed, ridge-regressed
+  against per-epoch comm seconds, with identifiability verdicts, the
+  planlint-verifiable ``measured_link_costs.json`` artifact, v4
+  ``attribution`` events, and the per-epoch critical-path analysis.
+* :mod:`timeline` — the fleet timeline export: journal + heartbeat files
+  merged into one Chrome-trace/Perfetto ``trace_event`` JSON (one track
+  per host), schema-validated and round-trip-checked.
+
 ``obs_tpu.py`` renders a run's journal (summary / tail / drift / compare),
-the performance artifacts (roofline / capacity / profile), and the live
-fleet status (watch / health).
+the performance artifacts (roofline / capacity / profile), the live
+fleet status (watch / health), and the attribution plane (attribute /
+timeline).
 """
 
 from .costs import (
@@ -49,6 +61,13 @@ from .costs import (
     roofline_report,
 )
 from .anomaly import ANOMALY_CAUSES, AnomalyDetector, mad_zscores
+from .attribution import (
+    LINK_COSTS_FORMAT,
+    attribute_run,
+    critical_path_report,
+    link_costs_artifact,
+    render_attribution,
+)
 from .drift import DriftMonitor, compose_predicted_rho, drift_report
 from .health import (
     HeartbeatEmitter,
@@ -70,6 +89,7 @@ from .journal import (
     validate_event,
 )
 from .telemetry import Telemetry, TelemetrySpec, telemetry_flush, telemetry_step
+from .timeline import build_timeline, timeline_for_run, validate_trace
 from .xprof import TraceParseError, overlap_report, profile_report
 
 __all__ = [
@@ -81,18 +101,23 @@ __all__ = [
     "FAULT_KINDS",
     "HeartbeatEmitter",
     "Journal",
+    "LINK_COSTS_FORMAT",
     "SCHEMA_VERSION",
     "Telemetry",
     "TelemetrySpec",
     "TraceParseError",
     "analyze_program",
     "append_journal_record",
+    "attribute_run",
+    "build_timeline",
     "fleet_status",
     "capacity_report",
     "chip_peaks",
     "compose_predicted_rho",
+    "critical_path_report",
     "drift_report",
     "epoch_series",
+    "link_costs_artifact",
     "mad_zscores",
     "make_event",
     "overlap_report",
@@ -100,10 +125,13 @@ __all__ = [
     "read_heartbeats",
     "read_journal",
     "read_journal_tail",
+    "render_attribution",
     "render_watch",
     "resolve_journal_path",
     "roofline_report",
     "telemetry_flush",
     "telemetry_step",
+    "timeline_for_run",
     "validate_event",
+    "validate_trace",
 ]

@@ -37,7 +37,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .anomaly import AnomalyDetector, liveness
-from .journal import append_journal_record, read_journal_tail
+from .attribution import critical_path_report
+from .journal import append_journal_record, fmt_value, read_journal_tail
 
 __all__ = ["HeartbeatEmitter", "heartbeat_path", "read_heartbeats",
            "worker_last_seen", "fleet_status", "render_watch"]
@@ -204,6 +205,17 @@ def fleet_status(source: str, now: Optional[float] = None,
     rates = [d["steps_per_sec"] for d in hosts.values()
              if d["steps_per_sec"] > 0]
     median_rate = float(np.median(rates)) if rates else 0.0
+    # critical-path tax over the tail window (DESIGN.md §18): each epoch
+    # barrier waits for its slowest host, so that host is charged the
+    # epoch's (max − median) seconds — the wall-clock a balanced fleet
+    # would have saved.  Single-host fleets tax 0 by construction.  One
+    # source of truth: the attribution plane's barrier attribution over
+    # the same heartbeat shape, so `watch` and `attribute` can never
+    # disagree about who gated an epoch.
+    crit_tax = critical_path_report((), heartbeats_by_host=by_host
+                                    )["tax_by_host"]
+    for host, d in hosts.items():
+        d["crit_tax_s"] = crit_tax.get(host, 0.0)
     last_seen = worker_last_seen(by_host)
     rows = []
     for host, d in sorted(hosts.items()):
@@ -226,6 +238,7 @@ def fleet_status(source: str, now: Optional[float] = None,
                 "steps_per_sec": d["steps_per_sec"],
                 "rate_vs_median": (d["steps_per_sec"] / median_rate
                                    if median_rate > 0 else None),
+                "crit_tax_s": d["crit_tax_s"],
                 "flags": flags,
             })
     return {
@@ -242,11 +255,7 @@ def fleet_status(source: str, now: Optional[float] = None,
 
 
 def _fmt(v, digits: int = 3) -> str:
-    if v is None:
-        return "-"
-    if isinstance(v, float):
-        return f"{v:.{digits}g}"
-    return str(v)
+    return fmt_value(v, digits)  # watch tables default to 3 digits
 
 
 def render_watch(status: dict, markdown: bool = False) -> str:
@@ -257,12 +266,13 @@ def render_watch(status: dict, markdown: bool = False) -> str:
     verdict = ("HEALTHY" if not status["flagged"] else
                f"ANOMALOUS ({len(status['anomalies'])} finding(s))")
     cols = ("worker", "host", "alive", "seen[s]", "rate/med", "partic",
-            "disagree", "flags")
+            "disagree", "crit[s]", "flags")
 
     def cells(r):
         return (r["worker"], r["host"], "yes" if r["alive"] else "NO",
                 _fmt(r["last_seen_age"]), _fmt(r["rate_vs_median"]),
                 _fmt(r["participation"]), _fmt(r["disagreement"]),
+                _fmt(r.get("crit_tax_s")),
                 ",".join(r["flags"]) or "-")
 
     if markdown:

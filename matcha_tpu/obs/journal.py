@@ -35,8 +35,8 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
 __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
-           "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "KIND_MIN_VERSION",
-           "REQUIRED_FIELDS",
+           "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "V4_KINDS",
+           "KIND_MIN_VERSION", "REQUIRED_FIELDS",
            "make_event", "validate_event", "Journal", "read_journal",
            "read_journal_tail", "resolve_journal_path", "latest_per_epoch",
            "epoch_series", "append_journal_record"]
@@ -46,10 +46,12 @@ __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
 #: v3 (ISSUE 10) is additive again: ``heartbeat`` (the live health plane's
 #: per-host liveness/progress record, mirrored from the per-host heartbeat
 #: files under ``health/``) and ``anomaly`` (a streaming detector's verdict
-#: with an attributed cause).  Every v1/v2 event validates verbatim under
-#: the v3 reader — pre-bump journals stay first-class sources.
-SCHEMA_VERSION = 3
-ACCEPTED_VERSIONS = frozenset({1, 2, 3})
+#: with an attributed cause).  v4 (ISSUE 11) adds ``attribution`` — the
+#: link-level cost estimator's per-matching seconds fit (obs.attribution).
+#: Every v1/v2/v3 event validates verbatim under the v4 reader — pre-bump
+#: journals stay first-class sources.
+SCHEMA_VERSION = 4
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4})
 
 #: Every kind a journal may contain.  The five fault kinds keep their
 #: historical ``faults.json`` names so the view stays a pure filter.
@@ -67,14 +69,20 @@ V2_KINDS = frozenset({"compile", "profile", "membership"})
 #: per-worker stats the anomaly detectors read; ``anomaly`` carries one
 #: detector verdict (subject + attributed cause).
 V3_KINDS = frozenset({"heartbeat", "anomaly"})
+#: Kinds introduced by schema v4 (ISSUE 11) — ``attribution`` carries one
+#: run of the per-matching cost estimator: the ridge fit of journaled
+#: per-epoch comm seconds against the reconstructed activation design
+#: matrix, with its identifiability verdict (obs.attribution).
+V4_KINDS = frozenset({"attribution"})
 #: Minimum envelope version per kind — the generalized "a vK kind claiming
 #: an earlier v is a lying envelope" rule.
 KIND_MIN_VERSION: Dict[str, int] = {
-    **{k: 2 for k in V2_KINDS}, **{k: 3 for k in V3_KINDS}}
+    **{k: 2 for k in V2_KINDS}, **{k: 3 for k in V3_KINDS},
+    **{k: 4 for k in V4_KINDS}}
 EVENT_KINDS = frozenset({
     "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
     "retrace", "bench",
-}) | FAULT_KINDS | V2_KINDS | V3_KINDS
+}) | FAULT_KINDS | V2_KINDS | V3_KINDS | V4_KINDS
 
 #: Kind-specific payload keys an event must carry to validate.  Kinds not
 #: listed need only the envelope (v / kind / t).
@@ -115,7 +123,25 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # worker or host being accused, ``cause`` the attributed failure mode
     "anomaly": frozenset({"epoch", "subject", "cause", "value",
                           "threshold"}),
+    # v4 (ISSUE 11): one per estimator run (obs.attribution) — the
+    # per-matching seconds fit.  ``per_matching_seconds`` carries null for
+    # unidentifiable matchings (``identifiable`` is the per-matching mask);
+    # ``source`` names where the comm series came from (journal epochs,
+    # heartbeats, or a planted scenario)
+    "attribution": frozenset({"epochs_used", "matchings", "identifiable",
+                              "base_seconds", "per_matching_seconds",
+                              "source"}),
 }
+
+
+def fmt_value(v, digits: int = 4) -> str:
+    """Table-cell formatter shared by every obs renderer (report / health /
+    attribution): ``None`` renders ``-``, floats general-format."""
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
 
 
 def make_event(kind: str, t: float, **fields) -> dict:

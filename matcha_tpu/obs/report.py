@@ -27,12 +27,7 @@ def _fmt_bytes(n: Optional[float]) -> str:
     return f"{n:.0f} B"
 
 
-def _fmt(v, digits=4) -> str:
-    if v is None:
-        return "-"
-    if isinstance(v, float):
-        return f"{v:.{digits}g}"
-    return str(v)
+from .journal import fmt_value as _fmt  # noqa: E402 — shared cell formatter
 
 
 def summarize(events: List[dict]) -> Dict:
@@ -81,6 +76,7 @@ def summarize(events: List[dict]) -> Dict:
     bench = [e for e in events if e.get("kind") == "bench"]
     compiles = [e for e in events if e.get("kind") == "compile"]
     profiles = [e for e in events if e.get("kind") == "profile"]
+    attributions = [e for e in events if e.get("kind") == "attribution"]
     total_bytes = sum(r["wire_bytes"] or 0.0 for r in rows) or None
     return {
         "start": start,
@@ -94,6 +90,7 @@ def summarize(events: List[dict]) -> Dict:
         "bench": bench,
         "compile": compiles,
         "profile": profiles,
+        "attribution": attributions,
         "total_wire_bytes": total_bytes,
         "events_total": len(events),
     }
@@ -185,6 +182,13 @@ def render_summary(events: List[dict], source: str = "events.jsonl") -> str:
         frac = e.get("overlap_fraction")
         lines.append(f"profile: {os.path.basename(str(e.get('source')))} "
                      f"overlap {'-' if frac is None else f'{frac:.1%}'}")
+    for e in digest["attribution"]:
+        ident = e.get("identifiable") or []
+        lines.append(
+            f"attribution: {sum(bool(b) for b in ident)}/{len(ident)} "
+            f"matchings identifiable over {e.get('epochs_used')} epochs "
+            f"(base {_fmt(e.get('base_seconds'), 3)} s/epoch, "
+            f"source {e.get('source')})")
     if digest["bench"]:
         lines.append(f"bench records: {len(digest['bench'])}")
     return "\n".join(lines)
@@ -218,7 +222,8 @@ def render_summary_markdown(events: List[dict],
                       f"(hosts: {', '.join(hosts)})"]
     for label, key in (("Fault", "faults"), ("Membership", "membership"),
                        ("Anomaly", "anomaly"),
-                       ("Drift", "drift"), ("Retrace", "retrace")):
+                       ("Drift", "drift"), ("Retrace", "retrace"),
+                       ("Attribution", "attribution")):
         if digest[key]:
             lines += ["", f"## {label} events", ""]
             for e in digest[key]:
@@ -283,6 +288,25 @@ def compare_sources(sources: Sequence[str]) -> Tuple[List[Dict], List[str]]:
             if src.endswith(".json"):
                 with open(src) as f:
                     rec = json.load(f)
+                # measured_link_costs.json (ISSUE 11): the attribution
+                # plane's artifact — the comparable number is the total
+                # identifiable matching seconds per activation, so two
+                # rounds' measured link economies land side by side
+                if str(rec.get("format", "")).startswith(
+                        "matcha_tpu.link_costs"):
+                    per = rec.get("per_matching", [])
+                    ident = [r for r in per if r.get("identifiable")]
+                    rows.append({
+                        "source": label,
+                        "value": (sum(float(r["seconds"]) for r in ident)
+                                  if ident else None),
+                        "unit": "matching_seconds_total",
+                        "backend": f"{len(ident)}/{len(per)} identifiable",
+                        "vs_baseline": None,
+                        "device_kind": None,
+                        "mfu": None,
+                    })
+                    continue
                 # MULTICHIP_r*.json: the driver's dryrun_multichip stamp
                 # (in-tree since r1, invisible to this CLI until ISSUE 8) —
                 # n_devices is the comparable number, ok/rc the verdict

@@ -40,6 +40,7 @@ from .cost import (
     calibrate_cost_model,
     expected_comm_units,
     load_measured_comm_times,
+    load_measured_link_costs,
     matching_comm_units,
 )
 from .spectral import (
@@ -74,6 +75,7 @@ __all__ = [
     "masked_laplacian_expectation",
     "load_fault_ledger",
     "load_measured_comm_times",
+    "load_measured_link_costs",
     "load_plan",
     "load_recorder_disagreement",
     "matching_comm_units",

@@ -41,6 +41,7 @@ __all__ = [
     "expected_comm_units",
     "calibrate_cost_model",
     "load_measured_comm_times",
+    "load_measured_link_costs",
 ]
 
 
@@ -80,11 +81,17 @@ class CostModel:
     unit-free (base 1, hop 1): rankings by predicted cost are then rankings
     by ``1 + units`` — already correct ordinally — and calibration only
     sharpens the *ratio* between topology choices into wall-clock.
+
+    ``fit`` is calibration provenance (which samples/epochs/sources fed the
+    coefficients) — ``None`` on the uncalibrated default, populated by
+    :func:`calibrate_cost_model` and :meth:`from_measured_link_costs` so an
+    artifact carrying a fitted model can always answer "fitted from what?".
     """
 
     base_step_s: float = 1.0
     per_hop_s: float = 1.0
     source: str = "uncalibrated"
+    fit: Optional[dict] = None
 
     def step_seconds(self, units: float) -> float:
         return self.base_step_s + self.per_hop_s * float(units)
@@ -96,11 +103,62 @@ class CostModel:
     def from_json(d: dict) -> "CostModel":
         return CostModel(base_step_s=float(d["base_step_s"]),
                          per_hop_s=float(d["per_hop_s"]),
-                         source=str(d.get("source", "uncalibrated")))
+                         source=str(d.get("source", "uncalibrated")),
+                         fit=d.get("fit"))
+
+    @staticmethod
+    def from_measured_link_costs(data, steps_per_epoch: Optional[int] = None
+                                 ) -> "CostModel":
+        """Bridge from a ``measured_link_costs.json`` artifact (the
+        attribution plane's output, ``obs.attribution``) to the planner's
+        affine model — what lets the reactive planner consume measured
+        per-link truth instead of the global uncalibrated default.
+
+        Accepts the parsed artifact dict or a path.  The identifiable
+        per-matching seconds (per *activation*) are regressed against the
+        plan's hop units for the artifact's topology and ``num_chips`` —
+        the same degenerate-safe affine fit as :func:`calibrate_cost_model`
+        (single-chip plans have every unit at 0, so the slope is honestly
+        unidentifiable and the base absorbs the mean).  The per-epoch base
+        overhead folds in as ``base_seconds / steps_per_epoch`` (the
+        artifact records its steps_per_epoch; the argument overrides).
+        Raises ``ValueError`` when the artifact has no identifiable
+        matching — an unidentifiable estimate must not silently become a
+        calibration.
+        """
+        data, label = load_measured_link_costs(data)
+        per = data.get("per_matching", [])
+        idx = [int(r["matching"]) for r in per if r.get("identifiable")]
+        if not idx:
+            raise ValueError(
+                f"{label}: no identifiable matching costs "
+                f"({data.get('reason') or 'estimator reported none'}) — "
+                f"refusing to calibrate from noise")
+        sched = data.get("schedule", {})
+        from .autotune import resolve_topology
+
+        decomposed, size, _ = resolve_topology(sched,
+                                               int(sched.get("seed", 0)))
+        units = matching_comm_units(decomposed, size,
+                                    int(data.get("num_chips", 1)))
+        theta = {int(r["matching"]): float(r["seconds"]) for r in per
+                 if r.get("identifiable")}
+        samples = [(float(units[j]), theta[j]) for j in idx]
+        spe = int(steps_per_epoch or data.get("steps_per_epoch") or 1)
+        model = calibrate_cost_model(
+            samples, source=f"measured_link_costs:{label}",
+            fit={"epochs_used": data.get("epochs_used"),
+                 "identifiable_matchings": idx,
+                 "comm_source": data.get("source"),
+                 "steps_per_epoch": spe})
+        base = max(float(data.get("base_seconds", 0.0)) / max(spe, 1), 0.0)
+        return dataclasses.replace(
+            model, base_step_s=model.base_step_s + base)
 
 
 def calibrate_cost_model(
-    samples: Sequence[Tuple[float, float]], source: str = "measured"
+    samples: Sequence[Tuple[float, float]], source: str = "measured",
+    fit: Optional[dict] = None,
 ) -> CostModel:
     """Least-squares fit of ``(units, seconds)`` pairs to the affine model.
 
@@ -110,19 +168,46 @@ def calibrate_cost_model(
     absorbs the mean.  Negative fitted coefficients are clamped to 0: a
     negative marginal hop cost is measurement noise, and propagating it
     would rank *more* communication as *faster*.
+
+    ``fit`` extends the recorded provenance (e.g. which epochs/sources the
+    samples came from); the sample count and units range are always
+    recorded, so a committed plan artifact shows what fed its model.
     """
     if not samples:
         raise ValueError("need at least one (units, seconds) sample")
     units = np.asarray([s[0] for s in samples], dtype=np.float64)
     secs = np.asarray([s[1] for s in samples], dtype=np.float64)
+    provenance = {
+        "samples": int(units.shape[0]),
+        "units_min": float(units.min()),
+        "units_max": float(units.max()),
+        **(fit or {}),
+    }
     if np.ptp(units) < 1e-12:
         return CostModel(base_step_s=float(secs.mean()), per_hop_s=0.0,
                          source=source + " (slope unidentifiable: "
-                                         "single units level)")
+                                         "single units level)",
+                         fit=provenance)
     A = np.stack([np.ones_like(units), units], axis=1)
     (c0, c1), *_ = np.linalg.lstsq(A, secs, rcond=None)
     c0, c1 = max(float(c0), 0.0), max(float(c1), 0.0)
-    return CostModel(base_step_s=c0, per_hop_s=c1, source=source)
+    return CostModel(base_step_s=c0, per_hop_s=c1, source=source,
+                     fit=provenance)
+
+
+def load_measured_link_costs(data) -> Tuple[dict, str]:
+    """Normalize a ``measured_link_costs.json`` input: a path or the parsed
+    dict; returns ``(data, label)`` and validates the format tag."""
+    label = "measured_link_costs"
+    if isinstance(data, str):
+        label = data
+        with open(data) as f:
+            data = json.load(f)
+    fmt = str(data.get("format", "")) if isinstance(data, dict) else ""
+    if not fmt.startswith("matcha_tpu.link_costs"):
+        raise ValueError(f"{label}: format {fmt!r} is not a "
+                         f"matcha_tpu.link_costs artifact")
+    return data, label
 
 
 def load_measured_comm_times(path: str) -> list:

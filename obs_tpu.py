@@ -64,11 +64,36 @@ Live health plane (DESIGN.md §17):
     files under ``RUN/health/`` (bounded reverse-tail reads — O(tail) per
     refresh, torn-line safe against concurrent writers): one row per
     worker (alive, last-seen age, step-rate vs fleet median,
-    participation, disagreement, anomaly flags) plus every detector
-    verdict over the tail window.  ``--once`` prints a single table and
-    exits 1 when anything is flagged (the CI / scripting form; a healthy
-    fleet exits 0); without it the table refreshes every ``--interval``
-    seconds until interrupted.  Exits 2 when no heartbeats exist.
+    participation, disagreement, critical-path tax, anomaly flags) plus
+    every detector verdict over the tail window.  ``--once`` prints a
+    single table and exits 1 when anything is flagged (the CI / scripting
+    form; a healthy fleet exits 0); without it the table refreshes every
+    ``--interval`` seconds until interrupted.  Exits 2 when no heartbeats
+    exist.
+
+Attribution plane (DESIGN.md §18):
+
+``attribute RUN [--out COSTS.json] [--md PATH] [--journal PATH]``
+    Measured per-matching link costs: regenerate the run's ``[T, M]``
+    activation flag stream from the journaled schedule seed, fold it into
+    the per-epoch design matrix, and ridge-regress the journaled per-epoch
+    comm seconds against it — per-matching seconds with confidence
+    intervals, an identifiability report, the per-link decomposition via
+    the folded execution plan, and the per-epoch critical-path table when
+    heartbeats exist.  ``--out`` writes the planlint-verifiable
+    ``measured_link_costs.json`` artifact; ``--journal`` appends the
+    schema-v4 ``attribution`` event.  Exits 1 when **nothing** is
+    identifiable (an unidentifiable run must fail loudly, not emit noise
+    as fact); exits 2 on unusable journals.
+
+``timeline RUN [--out trace.json]``
+    Fleet timeline export: merge the journal, the per-host heartbeat
+    files, and the anomaly events into one Chrome-trace/Perfetto
+    ``trace_event`` JSON — one track per host, compute/comm/compile/epoch
+    spans, instants for anomalies and membership churn, telemetry
+    counters.  The trace is schema-validated and round-trip-checked
+    (every journal/heartbeat event exactly once) before writing; exits 1
+    on validation failure.  Open the file at https://ui.perfetto.dev.
 
 ``RUN`` is a run directory (holding ``events.jsonl``) or a journal path.
 """
@@ -181,8 +206,15 @@ def _resolve_measured(args):
     for row in rows:
         if row.get("value") and row.get("unit") == "gossip_steps_per_sec":
             return float(row["value"])
-    print(f"# no gossip_steps_per_sec record in {args.source}",
-          file=sys.stderr)
+    # name what WAS there and what would have worked — "no record" alone
+    # sends the operator diffing JSON shapes by hand
+    found = sorted({str(r.get("unit")) for r in rows}) or ["nothing"]
+    print(f"# no gossip_steps_per_sec record in {args.source} (found "
+          f"units: {', '.join(found)}); accepted source shapes: a bench "
+          f"journal / run dir with `bench` events carrying "
+          f"unit=gossip_steps_per_sec, a BENCH_r*.json driver capture "
+          f"(record/parsed/tail wrappers ok), or a bench_live_r*.json "
+          f"record", file=sys.stderr)
     return None
 
 
@@ -260,6 +292,83 @@ def cmd_profile(args) -> int:
 
         for r in reports:
             append_journal_record(args.journal, "profile", **r)
+    return 0
+
+
+def cmd_attribute(args) -> int:
+    import json
+
+    from matcha_tpu.obs.attribution import (
+        attribute_run,
+        attribution_event_fields,
+        link_costs_artifact,
+        render_attribution,
+    )
+
+    events, path = _load(args.run)
+    report = attribute_run(events, steps_per_epoch=args.steps_per_epoch,
+                           ridge=args.ridge, num_chips=args.chips)
+    print(render_attribution(report))
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(render_attribution(report, markdown=True))
+        print(f"# markdown written to {args.md}", file=sys.stderr)
+    identifiable = any(report["identifiable"])
+    if args.out:
+        if identifiable:
+            with open(args.out, "w") as f:
+                json.dump(link_costs_artifact(report), f, indent=1,
+                          sort_keys=True)
+                f.write("\n")
+            # same self-check discipline as plan_tpu sweep: never emit an
+            # artifact the committed-artifact verifier would reject
+            from matcha_tpu.analysis import lint_plan_file, render_plan_text
+
+            violations, _ = lint_plan_file(args.out)
+            if violations:
+                print(render_plan_text(violations, [args.out]),
+                      file=sys.stderr)
+                print(f"# wrote {args.out}, but it FAILS planlint — do "
+                      f"not commit", file=sys.stderr)
+                return 1
+            print(f"# wrote {args.out}", file=sys.stderr)
+        else:
+            print(f"# not writing {args.out}: nothing identifiable",
+                  file=sys.stderr)
+    if args.journal and identifiable:
+        from matcha_tpu.obs import append_journal_record
+
+        append_journal_record(args.journal, "attribution",
+                              **attribution_event_fields(report))
+    if not identifiable:
+        print(f"obs_tpu: attribution unidentifiable — "
+              f"{report['reason'] or 'no separable matching'}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import json
+
+    from matcha_tpu.obs.timeline import (
+        render_timeline_summary,
+        timeline_for_run,
+        validate_trace,
+    )
+
+    trace = timeline_for_run(args.run)
+    problems = validate_trace(trace)
+    for p in problems:
+        print(f"obs_tpu: timeline invalid: {p}", file=sys.stderr)
+    if problems:
+        print(f"obs_tpu: {len(problems)} validation problem(s) — nothing "
+              f"written", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(trace, f, separators=(",", ":"), allow_nan=False)
+    print(render_timeline_summary(trace))
+    print(f"# trace written to {args.out}", file=sys.stderr)
     return 0
 
 
@@ -385,6 +494,34 @@ def main(argv=None) -> int:
         s.add_argument("--md", default=None,
                        help="also write the table as a markdown artifact")
         s.set_defaults(fn=cmd_watch)
+
+    s = sub.add_parser("attribute",
+                       help="measured per-matching/per-link costs from "
+                            "the journal (exit 1 when unidentifiable)")
+    s.add_argument("run", help="run dir (with events.jsonl) or journal path")
+    s.add_argument("--out", default=None,
+                   help="write the planlint-verifiable "
+                        "measured_link_costs.json here")
+    s.add_argument("--ridge", type=float, default=1e-8,
+                   help="ridge penalty on the per-matching coefficients")
+    s.add_argument("--chips", type=int, default=1,
+                   help="folded chip count for the per-link hop weighting")
+    s.add_argument("--steps-per-epoch", type=int, default=None,
+                   dest="steps_per_epoch",
+                   help="override the journal's recorded steps/epoch")
+    s.add_argument("--md", default=None,
+                   help="also write the report as a markdown artifact")
+    s.add_argument("--journal", default=None,
+                   help="also append a schema-v4 `attribution` event here")
+    s.set_defaults(fn=cmd_attribute)
+
+    s = sub.add_parser("timeline",
+                       help="export the run as a Perfetto/Chrome trace")
+    s.add_argument("run", help="run dir (with events.jsonl and optionally "
+                               "health/) or journal path")
+    s.add_argument("--out", default="trace.json",
+                   help="trace_event JSON output path (default trace.json)")
+    s.set_defaults(fn=cmd_timeline)
 
     s = sub.add_parser("profile",
                        help="overlap truth from executed profiler traces")

@@ -121,6 +121,7 @@ def _cost_model(args) -> CostModel:
         print(f"# calibrating from {args.calibrate}: assumes its runs used "
               f"the topology/--chips being planned here", file=sys.stderr)
         samples = []
+        budgets = []
         for spec in _topology_specs(args):
             decomposed, size, _ = resolve_topology(spec, args.seed)
             Ls = matching_laplacians(decomposed, size)
@@ -130,7 +131,13 @@ def _cost_model(args) -> CostModel:
                     Ls, budget, iters=args.solver_iters)
                 samples.append(
                     (expected_comm_units(probs, units_of), seconds))
-        return calibrate_cost_model(samples, source=args.calibrate)
+                budgets.append(float(budget))
+        # provenance rides the model into the artifact: which measurement
+        # file and which budget rows fed the coefficients
+        return calibrate_cost_model(
+            samples, source=args.calibrate,
+            fit={"calibration_file": args.calibrate,
+                 "budgets": sorted(set(budgets)), "chips": args.chips})
     return CostModel()
 
 
@@ -329,8 +336,45 @@ def cmd_verify(args) -> int:
     artifact = load_plan(args.plan)
     report = verify_plan_run(artifact, args.run_dir, args.steps_per_epoch,
                              rank=args.rank)
+    ok = bool(report["consistent"])
+    if args.link_costs:
+        # the measured-link-costs companion (obs_tpu.py attribute): the
+        # artifact must pass its own planlint rules (PL009–011) AND belong
+        # to the plan being verified — same matching count, or the measured
+        # θ prices a schedule this plan never runs
+        from matcha_tpu.analysis import lint_link_costs_data
+        from matcha_tpu.plan import load_measured_link_costs
+
+        try:
+            data, label = load_measured_link_costs(args.link_costs)
+            violations = [f"{v.rule} {v.message}"
+                          for v in lint_link_costs_data(data, label)]
+        except Exception as e:
+            # an unreadable / wrong-format / tampered artifact is a verify
+            # FAILURE in the report, never a traceback that swallows the
+            # run-consistency verdict computed above
+            data, label = {}, str(args.link_costs)
+            violations = [f"PL009 artifact unusable: "
+                          f"{type(e).__name__}: {e}"]
+        plan_m = len(artifact.chosen.get("probs", []))
+        costs_m = len(data.get("per_matching", []))
+        link_report = {
+            "path": label,
+            "violations": violations,
+            "matchings": costs_m,
+            "plan_matchings": plan_m,
+            "identifiable": sum(1 for r in data.get("per_matching", [])
+                                if isinstance(r, dict)
+                                and r.get("identifiable")),
+        }
+        if costs_m != plan_m:
+            link_report["violations"].append(
+                f"PL010 link-costs artifact prices {costs_m} matchings but "
+                f"the plan's chosen candidate has {plan_m}")
+        report["link_costs"] = link_report
+        ok = ok and not link_report["violations"]
     print(json.dumps(report, indent=1))
-    return 0 if report["consistent"] else 1
+    return 0 if ok else 1
 
 
 def cmd_simulate(args) -> int:
@@ -444,6 +488,10 @@ def main(argv=None) -> int:
     sp.add_argument("--steps-per-epoch", type=int, required=True,
                     dest="steps_per_epoch")
     sp.add_argument("--rank", type=int, default=0)
+    sp.add_argument("--link-costs", default=None, dest="link_costs",
+                    help="measured_link_costs.json (obs_tpu.py attribute) "
+                         "to verify against this plan: PL009-011 + "
+                         "matching-count cross-check; failures exit 1")
     sp.set_defaults(fn=cmd_verify)
 
     args = p.parse_args(argv)

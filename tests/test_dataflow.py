@@ -488,10 +488,14 @@ PLAN_DIR = REPO / "benchmarks"
 
 
 def _committed_plan():
-    files = discover_plan_files([PLAN_DIR])
-    assert files, "no committed plan artifact under benchmarks/ — ISSUE 6 " \
+    # discover_plan_files also surfaces the measured_link_costs family
+    # (ISSUE 11) — the tampering suite below wants a *plan*-format artifact
+    plans = [json.loads(f.read_text()) for f in discover_plan_files([PLAN_DIR])]
+    plans = [d for d in plans
+             if str(d.get("format", "")).startswith("matcha_tpu.plan")]
+    assert plans, "no committed plan artifact under benchmarks/ — ISSUE 6 " \
                   "ships benchmarks/plan_ring16.json"
-    return json.loads(files[0].read_text())
+    return plans[0]
 
 
 def test_every_committed_plan_artifact_verifies():
@@ -544,7 +548,7 @@ def test_planlint_ignores_non_plan_json(tmp_path):
 
 
 def test_plan_checks_documented():
-    assert set(PLAN_CHECKS) == {f"PL00{i}" for i in range(1, 9)}
+    assert set(PLAN_CHECKS) == {f"PL{i:03d}" for i in range(1, 12)}
     for what in PLAN_CHECKS.values():
         assert what  # lint-plan --list-checks has substance
 
@@ -556,7 +560,11 @@ def test_lint_plan_cli_clean_and_tampered(tmp_path, capsys):
 
     assert lint_tpu.main(["lint-plan", str(PLAN_DIR)]) == 0
     out = capsys.readouterr().out
-    assert "0 violation(s)" in out and "1 plan artifact" in out
+    # count dynamically: new per-round captures (e.g. a committed
+    # measured_link_costs_r7.json) must not break the pin
+    n = len(discover_plan_files([PLAN_DIR]))
+    assert n >= 2  # plan_ring16.json + measured_link_costs_ring8.json
+    assert "0 violation(s)" in out and f"{n} plan artifact" in out
 
     d = copy.deepcopy(_committed_plan())
     d["chosen"]["rho"] = 0.123
@@ -673,7 +681,8 @@ def test_lint_plan_works_from_any_cwd(tmp_path, monkeypatch, capsys):
 
     monkeypatch.chdir(tmp_path)
     assert lint_tpu.main(["lint-plan"]) == 0  # default benchmarks/ resolves
-    assert "1 plan artifact" in capsys.readouterr().out
+    n = len(discover_plan_files([PLAN_DIR]))
+    assert f"{n} plan artifact" in capsys.readouterr().out
 
 
 def test_gl101_empty_or_malformed_hint_is_a_violation_not_a_pass(tmp_path):

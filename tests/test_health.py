@@ -26,6 +26,7 @@ from matcha_tpu.elastic import (
     load_membership_trace,
 )
 from matcha_tpu.obs import read_journal, read_journal_tail, validate_event
+from matcha_tpu.obs.journal import SCHEMA_VERSION
 from matcha_tpu.obs.anomaly import AnomalyDetector, liveness, mad_zscores
 from matcha_tpu.obs.health import (
     HeartbeatEmitter,
@@ -170,12 +171,14 @@ def test_heartbeat_emitter_schema_ewma_and_layout(tmp_path):
     hb2 = em.beat(epoch=1, step=8, steps=4.0, epoch_time=1.2, comm_time=0.2,
                   workers={"w0": _w(1.0, 0.01, slot=0)})
     assert hb2["step_time_ewma"] == pytest.approx(0.5 * 0.3 + 0.5 * 0.1)
-    # the on-disk records are valid v3 journal events with absolute t
+    # the on-disk records are valid journal events (stamped at the
+    # writer's current schema version, >= the heartbeat kind's v3 minimum)
+    # with absolute t
     path = heartbeat_path(str(tmp_path / "health"), "host0")
     events = read_journal(path)
     assert len(events) == 2
     for e in events:
-        assert validate_event(e) == [] and e["v"] == 3
+        assert validate_event(e) == [] and e["v"] == SCHEMA_VERSION
         assert e["kind"] == "heartbeat" and e["t"] >= before
     assert events[1]["comp_time"] == pytest.approx(1.0)
     # comm_time can never exceed the epoch wall (clamped, comp stays >= 0)
@@ -547,7 +550,7 @@ def test_chaos_detected_from_heartbeat_records_alone(chaos_run):
     assert ("w3", "dead") in convicted
     assert ("w5", "straggler") in convicted
     for a in anomalies:
-        assert validate_event(a) == [] and a["v"] == 3
+        assert validate_event(a) == [] and a["v"] == SCHEMA_VERSION
     dead = [a for a in anomalies if a["cause"] == "dead"]
     assert {a["epoch"] for a in dead} == {1, 2}  # exactly the dead window
     assert all(a["value"] <= a["threshold"] for a in dead)

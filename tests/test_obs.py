@@ -174,15 +174,19 @@ def test_reference_journal_validates_line_by_line():
     at v3 with the live health plane: the recipe gained a period-4
     fault-plan straggler on w5 (4-step epochs ⇒ participation exactly
     0.25), so the journal commits one `heartbeat` per epoch and the
-    streaming detector's `straggler` `anomaly` verdicts naming w5."""
+    streaming detector's `straggler` `anomaly` verdicts naming w5.
+    ISSUE 11 re-pins at v4 with the attribution plane: the regeneration
+    script appends one `attribution` event from a planted heterogeneous-
+    link scenario (matching 1 priced 3x matching 0), so the estimator's
+    recovered per-matching seconds are committed evidence too."""
     events = read_journal(str(REPO / "benchmarks" / "events_ring8.jsonl"))
     assert events, "reference journal is empty"
     for i, e in enumerate(events):
         assert validate_event(e) == [], f"line {i + 1}: {validate_event(e)}"
-    assert {e["v"] for e in events} == {3}
+    assert {e["v"] for e in events} == {4}
     kinds = {e["kind"] for e in events}
     assert {"run_start", "epoch", "telemetry", "compile",
-            "membership", "heartbeat", "anomaly"} <= kinds
+            "membership", "heartbeat", "anomaly", "attribution"} <= kinds
     leave, rejoin = [e for e in events if e["kind"] == "membership"]
     assert (leave["epoch"], rejoin["epoch"]) == (2, 5)
     assert [t["kind"] for t in leave["trigger"]] == ["leave"]
@@ -229,6 +233,16 @@ def test_reference_journal_validates_line_by_line():
     epochs, d = epoch_series(events, "telemetry", "disagreement_mean")
     assert epochs == sorted(epochs) and len(epochs) >= 6
     assert all(v > 0 for v in d)
+    # v4 attribution plane: the planted heterogeneous-link scenario is
+    # recovered — both matchings identifiable, matching 1 priced 3x
+    # matching 0 (the regeneration script's PLANTED_MATCHING_SECONDS)
+    [attr] = [e for e in events if e["kind"] == "attribution"]
+    assert attr["source"].startswith("planted:")
+    assert attr["identifiable"] == [True, True]
+    theta = attr["per_matching_seconds"]
+    assert theta[0] == pytest.approx(0.02, rel=1e-3)
+    assert theta[1] == pytest.approx(0.06, rel=1e-3)
+    assert attr["base_seconds"] == pytest.approx(0.01, rel=1e-3)
 
 
 def test_validate_event_rejects_drift():
@@ -310,6 +324,30 @@ def test_v3_kinds_are_versioned_and_v2_events_validate_verbatim():
     problems = validate_event({"v": 0, "kind": "epoch", "t": 1.0})
     assert any("v1 kind" in p for p in problems)
     assert any("v=0" in p for p in problems)
+
+
+def test_v4_kinds_are_versioned_and_v3_events_validate_verbatim():
+    """The v3→v4 bump (ISSUE 11) is additive the same way: every v3 event
+    validates verbatim under the v4 reader, and an `attribution` event
+    claiming v<=3 is a lying envelope."""
+    from matcha_tpu.obs.journal import EVENT_KINDS, V4_KINDS
+
+    assert V4_KINDS == {"attribution"}
+    assert V4_KINDS <= EVENT_KINDS
+    attr = {"v": 4, "kind": "attribution", "t": 1.0, "epochs_used": 8,
+            "matchings": 2, "identifiable": [True, False],
+            "base_seconds": 0.01, "per_matching_seconds": [0.02, None],
+            "source": "journal:epoch.comm_time"}
+    assert validate_event(attr) == []
+    for v in (1, 2, 3):
+        assert any("v4 kind" in p
+                   for p in validate_event({**attr, "v": v}))
+    assert any("missing" in p for p in validate_event(
+        {k: v for k, v in attr.items() if k != "identifiable"}))
+    # pre-bump events are untouched under the v4 reader
+    v3 = {"v": 3, "kind": "anomaly", "t": 1.0, "epoch": 0, "subject": "w5",
+          "cause": "straggler", "value": 0.25, "threshold": 0.9}
+    assert validate_event(v3) == []
 
 
 def test_read_journal_tail_is_bounded_and_exact(tmp_path):
