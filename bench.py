@@ -41,9 +41,10 @@ Worst case ≈ 8 min; healthy-TPU case ≈ 4-6 min.
 
 Flags:
   --smoke        tiny sizes for a CPU sanity run
-  --backend B    fused|dense|gather|shard_map|choco   (default fused — the
-                 Pallas VMEM-resident multi-step kernel; dense is the
-                 per-step MXU path)
+  --backend B    fused|dense|perm|gather|shard_map|choco   (default fused —
+                 the Pallas VMEM-resident multi-step W-stack kernel; dense
+                 is the per-step MXU path; perm streams only the [T, M]
+                 flag array — the A/B cell vs fused)
   --dtype D      bf16|f32                     (default bf16)
   --steps N      scan length per timing rep
   --chunk S      chain-composition chunk for the secondary chunked number
@@ -179,7 +180,7 @@ def time_backend(backend, sched, x, steps, dtype, chunk=1, block_d=None,
                           compute_dtype=compute_dtype, chunk=chunk,
                           block_d=block_d, w_window=w_window)
     flags = jnp.asarray(sched.flags, jnp.float32)
-    if backend in ("dense", "fused"):
+    if backend in ("dense", "fused", "perm"):
         x = x.astype(compute_dtype)  # state rides in the wire dtype end-to-end
 
     # Timing must force a (tiny) device->host readback: on tunneled backends
@@ -252,19 +253,25 @@ def overlap_wire_grid(sched, x, steps, n, dim, backend="dense", reps=2,
     return cells
 
 
-def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1):
-    """Per-step FLOP and HBM-byte model for the MXU backends, evaluated at
-    the measured rate.  The fused kernel's traffic model is derived in
-    matcha_tpu/parallel/pallas_gossip.py:1-23: per chain of T steps the state
-    moves once (2·N·D) and the W_t stack streams per D-block
-    ((D/block_d)·T·N²); per step that amortizes to 2·N·D/T + ceil(D/bd)·N².
-    The dense backend re-materializes the state every step (2·N·D + N²).
+def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1, m=0):
+    """Per-step FLOP and HBM-byte model for the Pallas/MXU backends,
+    evaluated at the measured rate.  The fused kernel's traffic model is
+    derived in matcha_tpu/parallel/pallas_gossip.py:1-23: per chain of T
+    steps the state moves once (2·N·D) and the W_t stack streams per
+    D-block ((D/block_d)·T·N²); per step that amortizes to
+    2·N·D/T + ceil(D/bd)·N².  The perm backend streams only the [T, M]
+    flag rows per D-block (ceil(D/bd)·M·4 bytes/step — the ~2000× lever)
+    and spends (4·M+2)·N·D VPU flops/step (gather-subtract, gate-scale,
+    f32 accumulate per matching; ``m`` is the matching count).  The dense
+    backend re-materializes the state every step (2·N·D + N²).
 
     With chunked composition (chunk=S > 1) each *original* step costs
     2·N²·D/S apply-FLOPs on the MXU plus ~2·N³ f32 compose-FLOPs (the
     [N,N]×[N,N] chunk products), and the streamed-W traffic shrinks ×S —
     FLOPs/bytes below count the work actually executed, so MFU stays an
-    honest utilization figure, not an algorithmic speedup claim."""
+    honest utilization figure, not an algorithmic speedup claim.  Perm's
+    MFU divides VPU flops by the MXU peak — a deliberate *under*statement
+    (the VPU peak is far lower), so a perm MFU can never inflate a claim."""
     import jax
 
     bytes_el = 2 if dtype == "bf16" else 4
@@ -276,6 +283,9 @@ def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1):
             flops_per_step = flops_per_step / chunk + 2.0 * n**3
             # compose reads the full f32 W stack once and writes 1/S of it
             bytes_per_step = bytes_per_step / chunk + (1 + 1 / chunk) * n * n * 4
+    elif backend == "perm":
+        flops_per_step = (4.0 * m + 2.0) * n * dim  # VPU, not MXU
+        bytes_per_step = d_blocks * m * 4.0  # the flag stream is the stream
     else:
         bytes_per_step = (2.0 * n * dim + n * n) * bytes_el
     achieved_tflops = flops_per_step * value / 1e12
@@ -317,8 +327,12 @@ def worker_main(args) -> int:
         return deadline - time.time()
 
     if args.backend != "fused":
-        # single-backend mode (diagnostics): time it per-step and report
-        value = time_backend(args.backend, sched, x, steps, args.dtype)
+        # single-backend mode (diagnostics): time it per-step and report.
+        # perm takes the Pallas tiling knobs (the record reports exactly
+        # the executed configuration); the other backends ignore them
+        kb = ({"block_d": args.block_d or 2048, "w_window": args.w_window}
+              if args.backend == "perm" else {})
+        value = time_backend(args.backend, sched, x, steps, args.dtype, **kb)
         record = {
             "metric": f"gossip-steps/sec @ {n} virtual workers, "
                       f"D={dim} (ResNet-20), MATCHA budget 0.5, {args.dtype}, "
@@ -330,6 +344,22 @@ def worker_main(args) -> int:
         }
         if args.backend == "dense":
             record.update(roofline("dense", value, n, dim, args.dtype))
+        elif args.backend == "perm":
+            from matcha_tpu.parallel import matching_wire_bytes
+
+            record.update(roofline("perm", value, n, dim, args.dtype,
+                                   block_d=kb["block_d"],
+                                   m=len(sched.probs)))
+            record["block_d"] = kb["block_d"]
+            record["w_window"] = kb["w_window"]
+            # the logical exchanged-row account (what telemetry counts):
+            # expected wire bytes per step = E[flags] · per-matching bytes
+            # — reported next to the HBM flag-stream model so the two byte
+            # meanings can never be conflated
+            wire = matching_wire_bytes(sched.decomposed, dim,
+                                       wire_dtype=args.dtype)
+            record["wire_bytes_per_step"] = float(
+                np.asarray(sched.probs) @ wire)
         # flush the measured record BEFORE the grid refinement: if the grid
         # dies (or the provisional clock kills the process mid-grid) the
         # parent salvages this line — the measurement must never be
@@ -744,9 +774,13 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--backend", default="fused",
-                   help="fused|dense|gather|shard_map|choco; gather and "
-                        "choco run orders of magnitude slower per step — pair "
-                        "them with --steps 200 or a rep takes minutes")
+                   help="fused|dense|perm|gather|shard_map|choco; perm is "
+                        "the permutation-form flag-stream kernel (A/B cell "
+                        "vs fused — its record carries the flag-stream "
+                        "bytes_per_step and the matching_wire_bytes "
+                        "exchanged-row account); gather and choco run "
+                        "orders of magnitude slower per step — pair them "
+                        "with --steps 200 or a rep takes minutes")
     p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     # the chain must be long enough that the fixed ~70ms launch/dispatch
     # overhead of the tunneled backend is noise on the marginal rate, and

@@ -1,32 +1,30 @@
 #!/usr/bin/env python
-"""Hardware probe: can the permutation form of W_t beat the dense MXU form?
+"""A/B harness: the permutation-form kernel vs the dense fused kernel.
 
-The fused kernel executes each gossip step as a dense ``W_t @ x`` on the MXU
-and streams the precomputed ``[T, N, N]`` W stack from HBM — that stream is
-the dominant HBM term of the per-step roofline (benchmarks/ROOFLINE.md).
-But W_t is structurally ``I − α·Σ_j flag[t,j]·L_j`` over perfect matchings,
-i.e. per row: ``(W_t x)_i = (1 − α·deg_i,t)·x_i + α·Σ_j flag[t,j]·x_{π_j(i)}``
-with the involutions π_j *static*.  The permutation form therefore needs only
-the ``[T, M]`` flag stream from HBM (≈2,000× smaller) and replaces the MXU
-dot with M static row-shuffles + weighted adds on the VPU.
+Since ISSUE 13 the perm form is a **production backend**
+(``matcha_tpu.parallel.perm_gossip_run`` — ``gossip_backend="perm"``), and
+this probe re-exports it instead of carrying its own copy: there is exactly
+one perm kernel in the repo, and the A/B below times the same program text
+training runs.  The dense side is likewise the production fused W-stack
+kernel (``fused_gossip_run``).  What remains probe-shaped is the protocol:
 
-Whether that wins is a pure hardware-scheduling question: the shuffle of a
-VMEM-resident ``[N, block_d]`` block is sublane data movement whose cost
-Mosaic decides, and the VPU flops (≈(M+2)·N·bd) are ~60× fewer than the
-MXU's 2·N²·bd but run on a ~50× slower unit.  So: measure, don't assume.
+* Both forms run bf16 in/out with f32 accumulate — the production fused
+  kernel's dtypes (bench.py default) — so the dense baseline streams
+  exactly the bytes it streams in production.
+* Correctness is checked on device against the dense form in f32 and GATES
+  the ratio: outputs that diverge beyond rounding drift mark the record
+  inconclusive and withhold the ratio (a silently mis-lowered gather must
+  not trigger integration).  The f32 gate avoids bf16's percent-scale
+  chain drift, which would blind it; a mis-lowered gather is
+  dtype-independent and O(1) off.
+* Writes one JSON record to --out; exits 0 even when inconclusive.  Run on
+  a live tunnel (tpu_session.sh, after the headline steps); ``--smoke``
+  pins CPU for an off-tunnel interpret-mode correctness check.
 
-Both forms run bf16 in/out with f32 accumulate — the production fused
-kernel's dtypes (bench.py default) — so the dense baseline streams exactly
-the bytes it streams in production.  Correctness is checked on device
-against the dense form and GATES the ratio: outputs that diverge beyond
-bf16 rounding drift mark the record inconclusive and withhold the ratio
-(a silently mis-lowered gather must not trigger integration).  Writes one
-JSON record to --out; exits 0 even when inconclusive.  Run on a live
-tunnel (tpu_session.sh, after the headline steps); `--smoke` pins CPU for
-an off-tunnel correctness check in interpret mode.
-
-Models the hot path of /root/reference/communicator.py:92-122 like bench.py;
-integrate as a gossip backend only if this measures a clear win.
+The hardware question it measures — can M VPU row-shuffles beat one MXU
+matmul once the W stream is gone? — feeds the
+``plan.cost.choose_gossip_backend`` gate together with the roofline's
+measured-vs-ceiling ratio (``obs_tpu.py roofline --backend both``).
 """
 
 from __future__ import annotations
@@ -41,6 +39,31 @@ import numpy as np
 
 N, D, T, BD, W, M = 256, 273258, 2000, 4096, 8, 10
 ALPHA = 0.37  # representative mixing weight; any fixed value works
+
+
+def random_involutions(rng, m: int, n: int) -> np.ndarray:
+    """M random involutions with fixed points (matching structure)."""
+    perms = np.empty((m, n), np.int64)
+    for j in range(m):
+        pi = np.arange(n)
+        pairs = rng.permutation(n)[: 2 * (n // 3)].reshape(-1, 2)
+        pi[pairs[:, 0]], pi[pairs[:, 1]] = pairs[:, 1], pairs[:, 0]
+        perms[j] = pi
+    return perms
+
+
+def laplacians_from_involutions(perms: np.ndarray,
+                                partnered: np.ndarray) -> np.ndarray:
+    """``L_j = D_j − A_j`` for each involution — what build_mixing_stack
+    composes into the dense W stack (the same W the perm form applies)."""
+    m, n = perms.shape
+    L = np.zeros((m, n, n), np.float32)
+    rows = np.arange(n)
+    for j in range(m):
+        L[j, rows, rows] = partnered[j]
+        on = partnered[j] > 0
+        L[j, rows[on], perms[j][on]] -= 1.0
+    return L
 
 
 def main() -> int:
@@ -64,105 +87,40 @@ def main() -> int:
     pin_platform("cpu" if args.smoke else None)
     import jax
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
+
+    from matcha_tpu.parallel import (
+        build_mixing_stack,
+        fused_gossip_run,
+        involution_tables,
+        perm_gossip_run,
+    )
 
     rng = np.random.default_rng(0)
-    # M random involutions with fixed points (matching structure) + a
+    perms, partnered = involution_tables(random_involutions(rng, M, N))
+    laplacians = laplacians_from_involutions(perms, partnered)
     # Bernoulli flag stream at the MATCHA-0.5-like activation rate
-    perms = np.empty((M, N), np.int32)
-    for j in range(M):
-        pi = np.arange(N)
-        pairs = rng.permutation(N)[: 2 * (N // 3)].reshape(-1, 2)
-        pi[pairs[:, 0]], pi[pairs[:, 1]] = pairs[:, 1], pairs[:, 0]
-        perms[j] = pi
-    partnered = (perms != np.arange(N)[None, :]).astype(np.float32)  # [M, N]
     flags = (rng.random((T, M)) < 0.5).astype(np.float32)
 
     @jax.jit
     def gen_x():
-        # bf16 state: the production fused kernel's wire dtype (bench.py
+        # bf16 state: the production kernels' wire dtype (bench.py
         # default) — the dense baseline must stream the same bytes it
         # really streams, or the perm/dense ratio is biased
         return jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.bfloat16)
 
     x = gen_x()
     jax.block_until_ready(x)
-    flags_d = jnp.asarray(flags)
-    partnered_d = jnp.asarray(partnered)
-
-    # --- dense reference: per-step W_t @ x via the W stack (MXU form) ------
-    @jax.jit
-    def build_w_stack():
-        eye = jnp.eye(N, dtype=jnp.float32)
-        deg = flags_d @ partnered_d  # [T, N]
-        w = (1.0 - ALPHA * deg)[:, :, None] * eye[None]
-        onehot = jax.nn.one_hot(jnp.asarray(perms), N, dtype=jnp.float32)
-        # rows i with partner p get α at column p (fixed points already have
-        # their α·x_i folded into the diagonal term via deg=0)
-        for j in range(M):
-            w = w + (ALPHA * flags_d[:, j])[:, None, None] * (
-                partnered_d[j][None, :, None] * onehot[j][None])
-        return w  # f32; cast per use
-
-    def dense_kernel(x_ref, w_ref, o_ref):
-        t = pl.program_id(1)
-
-        @pl.when(t == 0)
-        def _():
-            o_ref[...] = x_ref[...]
-
-        for k in range(W):
-            o_ref[...] = jnp.dot(
-                w_ref[k], o_ref[...],
-                preferred_element_type=jnp.float32).astype(o_ref.dtype)
-        # (bf16 in/out, f32 accumulate — identical to pallas_gossip)
+    weights_d = jnp.asarray(ALPHA * flags, jnp.float32)  # [T, M] stream
 
     interp = jax.devices()[0].platform == "cpu"  # CPU: interpret-mode only
 
-    @jax.jit
     def run_dense(x, stk):
-        return pl.pallas_call(
-            dense_kernel, grid=(pl.cdiv(D, BD), T // W), interpret=interp,
-            in_specs=[pl.BlockSpec((N, BD), lambda i, t: (0, i)),
-                      pl.BlockSpec((W, N, N), lambda i, t: (t, 0, 0))],
-            out_specs=pl.BlockSpec((N, BD), lambda i, t: (0, i)),
-            out_shape=jax.ShapeDtypeStruct((N, D), x.dtype))(x, stk)
+        return fused_gossip_run(x, stk, block_d=BD, w_window=W,
+                                interpret=interp)
 
-    # --- permutation form: flags stream only, row gathers in VMEM ---------
-    # perms/partnered ride as (replicated-block) kernel inputs: Pallas
-    # forbids captured array constants, and as refs the gathers are traced
-    perms_d = jnp.asarray(perms, jnp.int32)  # [M, N]
-
-    def perm_kernel(x_ref, f_ref, pi_ref, pr_ref, o_ref):
-        t = pl.program_id(1)
-
-        @pl.when(t == 0)
-        def _():
-            o_ref[...] = x_ref[...]
-
-        pr = pr_ref[...]  # [M, N]
-        for k in range(W):
-            fk = f_ref[k]  # [M]
-            cur = o_ref[...].astype(jnp.float32)  # f32 accumulate, bf16 store
-            deg = fk @ pr  # [N]
-            acc = (1.0 - ALPHA * deg)[:, None] * cur
-            for j in range(M):
-                # row gather: partner rows of this matching (π_j involution)
-                g = jnp.take(cur, pi_ref[j], axis=0)
-                acc = acc + (ALPHA * fk[j] * pr[j])[:, None] * g
-            o_ref[...] = acc.astype(o_ref.dtype)
-
-    @jax.jit
-    def run_perm(x, flags):
-        return pl.pallas_call(
-            perm_kernel, grid=(pl.cdiv(D, BD), T // W), interpret=interp,
-            in_specs=[pl.BlockSpec((N, BD), lambda i, t: (0, i)),
-                      pl.BlockSpec((W, M), lambda i, t: (t, 0)),
-                      pl.BlockSpec((M, N), lambda i, t: (0, 0)),
-                      pl.BlockSpec((M, N), lambda i, t: (0, 0))],
-            out_specs=pl.BlockSpec((N, BD), lambda i, t: (0, i)),
-            out_shape=jax.ShapeDtypeStruct((N, D), x.dtype))(
-                x, flags, perms_d, partnered_d)
+    def run_perm(x, weights):
+        return perm_gossip_run(x, weights, perms, partnered, block_d=BD,
+                               w_window=W, interpret=interp)
 
     def rate(fn, *a):
         g = jax.jit(lambda *a: jnp.sum(fn(*a)[:, :8].astype(jnp.float32)))
@@ -176,28 +134,29 @@ def main() -> int:
 
     rec = {"probe": "perm-vs-dense-fused", "n": N, "d": D, "steps": T,
            "block_d": BD, "w_window": W, "matchings": M,
+           "kernel": "matcha_tpu.parallel.perm_gossip_run",  # the ONE copy
            "device_kind": jax.devices()[0].device_kind}
     if args.smoke:
         # interpret-mode numbers are correctness evidence only — a smoke
         # record must never impersonate hardware in the session artifact
         rec["smoke_interpret_mode"] = True
     try:
-        stk = build_w_stack()  # f32
-        jax.block_until_ready(stk)
+        stack32 = build_mixing_stack(laplacians, ALPHA, flags, jnp.float32)
+        jax.block_until_ready(stack32)
         # Correctness gate in f32 (same lowering path, no per-step rounding
-        # divergence — bf16's 8-bit mantissa drifts percent-scale over the
-        # chain even when both kernels are right, which would blind the
-        # gate).  A mis-lowered gather is dtype-independent and O(1) off.
-        y_dense = run_dense(x.astype(jnp.float32), stk)
-        y_perm = run_perm(x.astype(jnp.float32), flags_d)
+        # divergence).  Dense composes W_t from the SAME involutions the
+        # perm form gathers through, so agreement here is a proof about
+        # the lowering, not the math.
+        y_dense = run_dense(x.astype(jnp.float32), stack32)
+        y_perm = run_perm(x.astype(jnp.float32), weights_d)
         err = float(jnp.max(jnp.abs(y_perm - y_dense))
                     / (jnp.max(jnp.abs(y_dense)) + 1e-30))
         rec["rel_err_vs_dense_f32"] = err
         rec["valid"] = err < 1e-3
         # Rates in the production dtypes: bf16 state/stack, f32 accumulate
         rec["dense_steps_per_sec"] = round(
-            rate(run_dense, x, stk.astype(jnp.bfloat16)), 1)
-        rec["perm_steps_per_sec"] = round(rate(run_perm, x, flags_d), 1)
+            rate(run_dense, x, stack32.astype(jnp.bfloat16)), 1)
+        rec["perm_steps_per_sec"] = round(rate(run_perm, x, weights_d), 1)
         if not rec["valid"]:
             rec["inconclusive"] = "f32 outputs diverge; ratio withheld"
         elif args.smoke:

@@ -165,11 +165,25 @@ timeout -k 30 1500 python benchmarks/train_step_bench.py --model resnet50 \
 #     ceiling — integrate into pallas_gossip only if this measures a win)
 timeout -k 30 420 python benchmarks/split_probe.py --out benchmarks/split_probe.json
 
-# 2.55 permutation-form kernel probe: stream only the [T, M] flags instead
-#      of the [T, N, N] W stack and apply W_t as in-VMEM row gathers —
-#      raises the per-step ceiling if Mosaic lowers the gathers well
-#      (integrate as a backend only on a measured win)
+# 2.55 permutation-form kernel A/B: the probe now re-exports the
+#      PRODUCTION perm backend (matcha_tpu.parallel.perm_gossip_run —
+#      gossip_backend="perm" since ISSUE 13), so this times the same
+#      program text training runs; the correctness gate still withholds
+#      the ratio on divergence
 timeout -k 30 420 python benchmarks/perm_probe.py --out benchmarks/perm_probe.json
+
+# 2.56 perm backend bench cell + the perm-vs-fused roofline.  The bench
+#      record carries the flag-stream bytes_per_step and the
+#      matching_wire_bytes exchanged-row account; the roofline compare
+#      emits both kernels' ceilings from extracted compiled costs with
+#      the measured ratio naming its denominator backend — together they
+#      are the choose_gossip_backend gate's evidence pair.
+timeout -k 30 600 python bench.py --backend perm --journal "$OBS_JOURNAL" \
+    | tail -n 1 > benchmarks/perm_bench_r7.json
+timeout -k 10 300 python obs_tpu.py roofline --backend both \
+    --source benchmarks/perm_bench_r7.json \
+    --md benchmarks/roofline_perm_r7.md \
+    || echo "perm roofline: non-finite ceiling (see stderr)"
 
 # 2.6 CHOCO encode cost: exact vs TPU-native approximate top-k (and the
 #     other registry compressors) at the config-4 shape

@@ -32,6 +32,12 @@
 #  11. attribution lane  link-level attribution plane (per-matching cost
 #                    estimator, link-costs artifact, timeline export,
 #                    critical path), as pytest (marker: attribution)
+#  11.5 perm lane + smoke  permutation-form gossip backend (flag-stream
+#                    kernel parity vs the gather oracle, alive-mask
+#                    composition, overlap drain, backend selection), as
+#                    pytest (marker: perm); then the probe's --smoke
+#                    interpret-mode A/B — the production perm kernel must
+#                    reproduce the fused W-stack kernel in f32
 #  12. attribution smoke  obs_tpu.py timeline must validate + round-trip
 #                    the committed reference journal, and obs_tpu.py
 #                    attribute must exit NON-zero on it (its real comm
@@ -126,6 +132,19 @@ rm -rf "$HEALTH_DIR"
 echo "== attribution pytest lane =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m attribution -p no:cacheprovider || rc=1
+
+echo "== perm backend pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m perm -p no:cacheprovider || rc=1
+
+echo "== perm interpret-mode parity smoke (probe correctness gate) =="
+# the probe re-exports the production perm kernel; its --smoke run is the
+# off-tunnel A/B correctness gate — "valid": true means the flag-stream
+# kernel reproduced the dense W-stack kernel in f32 on the interpret path
+PERM_OUT="$(JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python \
+    benchmarks/perm_probe.py --smoke --reps 1)" || rc=1
+grep -q '"valid": true' <<<"$PERM_OUT" || { \
+    echo "perm smoke: correctness gate FAILED: $PERM_OUT"; rc=1; }
 
 echo "== attribution + timeline smoke (committed reference journal) =="
 TRACE_OUT="$(mktemp)"

@@ -72,6 +72,18 @@ def _perm_arg(call: ast.Call) -> Optional[ast.AST]:
     return None
 
 
+def _involution_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The ``perms`` argument of ``perm_gossip_run(x, weights, perms,
+    partnered, ...)`` — the static involution table stack the kernel's row
+    gathers execute."""
+    for kw in call.keywords:
+        if kw.arg == "perms":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
 def _check_pairs(pairs) -> Optional[str]:
     """None if ``pairs`` is a valid (source, dest) permutation; else why not.
 
@@ -102,20 +114,72 @@ def _check_pairs(pairs) -> Optional[str]:
     return None
 
 
+def _check_involutions(tables) -> Optional[str]:
+    """None if ``tables`` is a valid ``[M, N]`` total-involution stack;
+    else why not.
+
+    Validity per row: every entry an in-range int and ``π[π[i]] == i`` for
+    all i — a matching pairs slots symmetrically (fixed points map to
+    self).  A non-involution gather does not error in VMEM any more than a
+    one-sided ppermute errors on ICI: the asymmetric row silently double-
+    or zero-weights someone's state, the same corruption class.
+    """
+    try:
+        rows = [[int(v) for v in row] for row in list(tables)]
+    except (TypeError, ValueError):
+        return "does not evaluate to a list of integer index rows"
+    if not rows:
+        return ("empty table stack — zero matchings compiles an identity "
+                "kernel; build no kernel instead")
+    n = len(rows[0])
+    for j, row in enumerate(rows):
+        if len(row) != n:
+            return f"row {j} has length {len(row)} != {n} (ragged stack)"
+        if n == 0:
+            return f"row {j} is empty"
+        if any(v < 0 or v >= n for v in row):
+            bad = next(v for v in row if v < 0 or v >= n)
+            return f"row {j}: partner index {bad} out of range [0, {n})"
+        for i, v in enumerate(row):
+            if row[v] != i:
+                return (f"row {j} is not an involution: π(π({i})) = "
+                        f"{row[v]} != {i} — the matching is one-sided")
+    return None
+
+
 class GL101PermutationTables(Rule):
     id = "GL101"
-    title = "ppermute permutation table unverified or not a permutation"
+    title = "permutation/involution table unverified or invalid"
     invariant = (
-        "Every lax.ppermute perm table must be a permutation: pairwise "
-        "distinct sources, pairwise distinct dests, senders == receivers.  "
-        "A one-sided entry does not error on ICI — the unmatched receiver's "
-        "block arrives as zeros and gossip silently averages against "
-        "garbage.  Tables are verified by constant-folding the building "
-        "expression; tables closing over runtime values carry a "
-        "`# graftverify: bind NAME=lo..hi` hint and are verified for every "
-        "binding in the hint's cross product.  Genuinely dynamic tables "
-        "suppress with a review reason."
+        "Every lax.ppermute perm table must be a permutation (pairwise "
+        "distinct sources, pairwise distinct dests, senders == receivers) "
+        "and every perm_gossip_run involution stack must be total "
+        "involutions (π∘π = id, in-range).  Neither errors at runtime — a "
+        "one-sided ppermute entry zeroes the unmatched receiver's block on "
+        "ICI, a non-involution gather double-weights someone's rows in "
+        "VMEM — and gossip silently averages against garbage either way.  "
+        "Tables are verified by constant-folding the building expression; "
+        "tables closing over runtime values carry a `# graftverify: bind "
+        "NAME=lo..hi` hint and are verified for every binding in the "
+        "hint's cross product; schedule-built involution stacks route "
+        "through the `involution_tables` validator seam (the runtime half "
+        "of the proof).  Genuinely dynamic tables suppress with a review "
+        "reason."
     )
+
+    #: call leaf name -> (table-arg extractor, folded-value checker,
+    #: table label, failure phrase)
+    _TABLE_SITES = {
+        "ppermute": (_perm_arg, _check_pairs, "perm table",
+                     "is not a permutation"),
+        "perm_gossip_run": (_involution_arg, _check_involutions,
+                            "involution table stack",
+                            "is not a valid involution stack"),
+    }
+    #: sanctioned runtime validator for involution stacks: a table bound
+    #: from this call is checked at build time (raises on non-involution),
+    #: so the static rule accepts the seam instead of demanding a fold
+    _VALIDATOR = "involution_tables"
 
     def check(self, source: LintSource) -> List[Violation]:
         graph = module_graph(source)
@@ -125,42 +189,106 @@ class GL101PermutationTables(Rule):
             if not isinstance(node, ast.Call):
                 continue
             fn = dotted_name(node.func)
-            if fn is None or fn.split(".")[-1] != "ppermute":
+            leaf = fn.split(".")[-1] if fn else None
+            site = self._TABLE_SITES.get(leaf)
+            if site is None:
                 continue
-            perm = _perm_arg(node)
-            if perm is None:
+            extract, checker, label, bad = site
+            table = extract(node)
+            if table is None:
                 out.append(self.hit(
-                    source, node, "ppermute call without a perm table"))
+                    source, node, f"{leaf} call without a {label}"))
                 continue
-            out.extend(self._verify(source, graph, hints, node, perm))
+            out.extend(self._verify(source, graph, hints, node, table,
+                                    checker, label, bad,
+                                    seam=(leaf == "perm_gossip_run")))
         return out
+
+    def _is_validator_call(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            fn = dotted_name(expr.func)
+            return fn is not None and fn.split(".")[-1] == self._VALIDATOR
+        if isinstance(expr, ast.Subscript):  # involution_tables(p)[0]
+            return self._is_validator_call(expr.value)
+        return False
+
+    def _routed_through_validator(self, graph: ModuleGraph, call: ast.Call,
+                                  name: str) -> bool:
+        """True when ``name`` is bound exactly once in the *outermost*
+        enclosing scope, from an ``involution_tables(...)`` call (plain or
+        tuple-unpacked: ``pi, pr = involution_tables(perms)``), and never
+        mutated.  Outermost, not innermost: the kernel call typically sits
+        inside a closure (``mix``/``multi_step``) while the tables are
+        built once in the backend factory around it; the single-binding +
+        no-mutation requirement keeps the widened search conservative."""
+        search: ast.AST = graph.source.tree
+        line = getattr(call, "lineno", None)
+        outer_lo = None
+        for fn_nodes in graph.functions.values():
+            for fn in fn_nodes:
+                lo = getattr(fn, "lineno", None)
+                hi = getattr(fn, "end_lineno", None)
+                if lo is None or hi is None or line is None:
+                    continue
+                if lo <= line <= hi and (outer_lo is None or lo < outer_lo):
+                    outer_lo, search = lo, fn
+        bindings: List[ast.AST] = []
+        for n in ast.walk(search):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    names = [e.id for e in ast.walk(t)
+                             if isinstance(e, ast.Name)]
+                    if name in names:
+                        bindings.append(n.value)
+            elif isinstance(n, ast.AugAssign) \
+                    and isinstance(n.target, ast.Name) \
+                    and n.target.id == name:
+                return False
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in self._MUTATORS \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id == name:
+                return False
+        return len(bindings) == 1 and self._is_validator_call(bindings[0])
 
     def _verify(self, source: LintSource, graph: ModuleGraph,
                 hints: Dict[int, Dict[str, List[int]]],
-                call: ast.Call, perm: ast.AST) -> List[Violation]:
+                call: ast.Call, perm: ast.AST, checker, label: str,
+                bad: str, seam: bool = False) -> List[Violation]:
+        if seam and self._is_validator_call(perm):
+            return []  # table built inline through the validator seam
         binds: Dict[str, List[int]] = dict(hints.get(call.lineno, {}))
         expr = perm
         if isinstance(perm, ast.Name):
+            if seam and self._routed_through_validator(graph, call, perm.id):
+                return []  # runtime-validated: involution_tables raises
             assign = self._single_assignment(graph, call, perm.id)
             if assign is not None:
                 expr = assign.value
                 binds.update(hints.get(assign.lineno, {}))
             else:
+                fix = (f"route it through {self._VALIDATOR}(...) "
+                       f"(runtime-validated seam), build it in one "
+                       f"expression (with a bind hint if it closes over "
+                       f"runtime values)" if seam else
+                       "build the table in one expression (with a bind "
+                       "hint if it closes over runtime values)")
                 return [self.hit(
                     source, call,
-                    f"perm table `{perm.id}` has no unique unmutated local "
-                    f"assignment — not statically verifiable; build the "
-                    f"table in one expression (with a bind hint if it "
-                    f"closes over runtime values), or suppress with a "
-                    f"review reason")]
+                    f"{label} `{perm.id}` has no unique unmutated local "
+                    f"assignment — not statically verifiable; {fix}, or "
+                    f"suppress with a review reason")]
         missing = sorted(free_names(expr) - set(binds))
         if missing:
             return [self.hit(
                 source, call,
-                f"perm table depends on runtime value(s) {missing} — add "
+                f"{label} depends on runtime value(s) {missing} — add "
                 f"`# graftverify: bind {missing[0]}=lo..hi` (all free "
-                f"symbols) so the table can be verified parametrically, or "
-                f"suppress with a review reason")]
+                f"symbols) so the table can be verified parametrically"
+                + (f", route it through {self._VALIDATOR}(...)" if seam
+                   else "")
+                + ", or suppress with a review reason")]
         combos = expand_bindings(binds)
         if not combos:
             # a reversed range (`C=8..1`) or malformed value list expands to
@@ -172,31 +300,31 @@ class GL101PermutationTables(Rule):
                 f"nothing was verified; check the hint's ranges/values")]
         for binding in combos:
             try:
-                pairs = const_eval(expr, dict(binding))
+                tables = const_eval(expr, dict(binding))
             except NotFoldable as e:
                 return [self.hit(
                     source, call,
-                    f"perm table is outside the statically-evaluable subset "
+                    f"{label} is outside the statically-evaluable subset "
                     f"({e}) — simplify the building expression or suppress "
                     f"with a review reason")]
             except ZeroDivisionError:
                 return [self.hit(
                     source, call,
-                    f"perm table evaluation divides by zero under binding "
+                    f"{label} evaluation divides by zero under binding "
                     f"{binding} — exclude 0 from the bind hint ranges")]
             except Exception as e:  # a broken expression/hint must report,
                 # never abort the whole lint run (review finding, ISSUE 6)
                 return [self.hit(
                     source, call,
-                    f"perm table evaluation raised "
+                    f"{label} evaluation raised "
                     f"{type(e).__name__}: {e} under binding {binding} — "
                     f"fix the expression or the hint ranges")]
-            why = _check_pairs(pairs)
+            why = checker(tables)
             if why is not None:
                 where = f" under binding {binding}" if binding else ""
                 return [self.hit(
                     source, call,
-                    f"perm table is not a permutation{where}: {why}")]
+                    f"{label} {bad}{where}: {why}")]
         return []
 
     _MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",

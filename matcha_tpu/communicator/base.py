@@ -62,7 +62,12 @@ class Communicator:
     ``multi_step``, when present, runs a whole flag stream in one fused
     launch (e.g. the Pallas VMEM-resident gossip kernel) — arithmetically
     equivalent to scanning ``step``, used by ``run`` for consensus-only
-    phases and the micro-benchmark.
+    phases and the micro-benchmark.  ``multi_step_masked`` is its
+    survivor-aware twin ``(flat, carry, flags[T,M], alive[N]) -> (flat,
+    carry)`` for backends whose fused form composes the mask per edge
+    in-kernel (the permutation-form kernel does; the W-stack kernel cannot
+    — its mixing matrices are precomputed maskless).  ``run`` uses it for
+    constant-``alive`` chains; per-step ``[T, N]`` masks always scan.
 
     ``encode_probe``, when present, is a scan-compatible stand-in for the
     per-step message *encode* work (CHOCO's compress path) —
@@ -76,6 +81,7 @@ class Communicator:
     init: Callable[[jax.Array], Any]
     step: StepFn
     multi_step: Any = None  # Optional[(flat, carry, flags[T,M]) -> (flat, carry)]
+    multi_step_masked: Any = None  # Optional[(flat, carry, flags, alive[N])]
     encode_probe: Any = None  # Optional[(flat, probe_state) -> probe_state]
 
     def begin_mix(self, flat: jax.Array, carry: Any, flags_t: jax.Array,
@@ -174,10 +180,13 @@ class Communicator:
 
         ``alive``: optional survivor mask — ``f32[N]`` (held constant for
         the chain) or ``f32[T, N]`` (per-step, scanned alongside the flags).
-        Masked chains always take the per-step scan: ``multi_step`` fusions
-        (the Pallas W-stack kernel) precompute mixing matrices that do not
-        know about survivors, so bypassing them is a correctness requirement,
-        not a missing optimization."""
+        A constant mask uses ``multi_step_masked`` when the backend offers
+        one (the permutation-form kernel gates edges in-kernel, so masked
+        chains keep the fused launch); otherwise masked chains take the
+        per-step scan — ``multi_step`` fusions like the Pallas W-stack
+        kernel precompute mixing matrices that do not know about
+        survivors, so bypassing them is a correctness requirement, not a
+        missing optimization."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -201,6 +210,8 @@ class Communicator:
             return x, c
 
         alive = jnp.asarray(alive, jnp.float32)
+        if alive.ndim == 1 and self.multi_step_masked is not None:
+            return self.multi_step_masked(flat, carry, flags, alive)
         if alive.ndim == 1:
             def body_const(state, flags_t):
                 x, c = state

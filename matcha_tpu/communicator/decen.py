@@ -37,7 +37,41 @@ from ..parallel import (
 from ..schedule import Schedule
 from .base import Communicator
 
-__all__ = ["make_decen"]
+__all__ = ["make_decen", "resolve_gossip_backend"]
+
+
+def resolve_gossip_backend(schedule, mesh=None, requested: str = "auto",
+                           dim=None, wire_dtype=None,
+                           measured_vs_ceiling=None) -> dict:
+    """Resolve a ``gossip_backend`` request to the backend actually built,
+    returning the full decision record for journaling.
+
+    Non-``auto`` requests pass through verbatim (the record says so).
+    ``auto`` keeps the historical multi-device answer — ``shard_map`` when
+    a real mesh exists (physical decentralization: ICI carries only gossip
+    edges) — and on a single chip delegates the perm-vs-dense call to
+    :func:`matcha_tpu.plan.cost.choose_gossip_backend`, the planner's
+    per-backend cost ledger gated on the roofline's measured-vs-ceiling
+    ratio.  One resolver on purpose: :func:`make_decen` and the train loop
+    both call it, so the journaled decision is definitionally the backend
+    that compiled.
+    """
+    if requested != "auto":
+        return {"requested": requested, "chosen": requested,
+                "reason": "explicit config; no selection ran"}
+    if mesh is not None and mesh.size > 1:
+        return {"requested": "auto", "chosen": "shard_map",
+                "reason": f"multi-device mesh ({mesh.size} devices): "
+                          f"worker-folded ppermute plan rides ICI"}
+    from ..plan.cost import choose_gossip_backend
+
+    return choose_gossip_backend(
+        schedule.num_workers, schedule.num_matchings, dim=dim,
+        wire_dtype=wire_dtype,
+        budget=float(np.mean(np.asarray(schedule.probs)))
+        if len(schedule.probs) else None,
+        topology=getattr(schedule, "name", None),
+        measured_vs_ceiling=measured_vs_ceiling)
 
 
 def make_decen(
@@ -58,6 +92,19 @@ def make_decen(
       * ``"fused"``     — dense per-step, plus the Pallas multi-step kernel
                           (VMEM-resident state, streamed W_t stack) for whole
                           flag streams — the bench configuration.
+      * ``"perm"``      — the permutation-form Pallas kernel for *every*
+                          phase: each step is M static-involution row
+                          gathers + weighted adds on a VMEM-resident state
+                          block, streaming only the ``[T, M]`` flag array
+                          from HBM (~2000× less than the fused W stack at
+                          N=256; the only representable form at 10k+
+                          workers).  Alive masks compose in-kernel
+                          (per-edge ``alive_i·alive_{π_j(i)}`` gates), so
+                          masked chains keep the fused launch
+                          (``multi_step_masked``); bf16 wire rides the
+                          ``resolve_wire_dtype`` seam with f32
+                          accumulation; interpret mode makes the whole
+                          backend exact on the CPU tier-1 mesh.
       * ``"gather"``    — per-matching static gathers (any N under jit).
       * ``"skip"``      — per-matching ``lax.cond``: inactive matchings are
                           not executed, so the MATCHA budget buys back real
@@ -72,7 +119,14 @@ def make_decen(
       * ``"shard_map"`` — explicit ppermute plan over ``mesh`` (worker-sharded,
                           the physical-decentralization path where ICI carries
                           only gossip edges).
-      * ``"auto"``      — shard_map on a multi-device mesh, else dense.
+      * ``"auto"``      — shard_map on a multi-device mesh; single-chip the
+                          perm-vs-dense choice runs through
+                          ``plan.cost.choose_gossip_backend`` (forced perm
+                          beyond the representability wall, gated on the
+                          roofline's measured-vs-ceiling ratio otherwise —
+                          dense when no measurement exists).  The train
+                          loop journals the decision record (``backend``
+                          event) so drift can score it.
 
     ``chunk`` (fused backend only): collapse runs of ``chunk`` consecutive
     mixing matrices into their product before the Pallas kernel — exactly the
@@ -112,21 +166,24 @@ def make_decen(
         compute_dtype = wire
 
     if backend == "auto":
-        backend = "shard_map" if (mesh is not None and mesh.size > 1) else "dense"
+        backend = resolve_gossip_backend(schedule, mesh,
+                                         wire_dtype=wire_dtype)["chosen"]
 
-    if backend != "fused" and (block_d is not None or w_window != 1):
+    if backend not in ("fused", "perm") \
+            and (block_d is not None or w_window != 1):
         import warnings
 
         warnings.warn(
-            f"block_d/w_window tune the fused backend's Pallas kernel; "
-            f"backend '{backend}' ignores them. Note the fused kernel runs "
-            f"multi-step *chains* (Communicator.run / the comm-split "
-            f"timer) — the per-step training mix is a single dense matmul "
-            f"either way.",
+            f"block_d/w_window tune the fused/perm backends' Pallas "
+            f"kernels; backend '{backend}' ignores them. Note the fused "
+            f"kernel runs multi-step *chains* (Communicator.run / the "
+            f"comm-split timer) — the per-step training mix is a single "
+            f"dense matmul either way.",
             stacklevel=2,
         )
 
     multi_step = None
+    multi_step_masked = None
     if backend == "gather":
         if perms.shape[1] >= 64:
             import warnings
@@ -174,6 +231,35 @@ def make_decen(
             return fused_gossip_run(flat, stack, interpret=interpret,
                                     **kernel_kwargs), carry
 
+    elif backend == "perm":
+        from ..parallel import involution_tables, perm_gossip_run
+
+        perms_i32, partnered = involution_tables(perms)
+        interpret = jax.default_backend() != "tpu"
+        kernel_kwargs = {"wire_dtype": wire_dtype, "interpret": interpret}
+        if block_d is not None:
+            kernel_kwargs["block_d"] = block_d
+        if w_window > 1:
+            kernel_kwargs["w_window"] = w_window
+
+        # ONE kernel for every phase: the per-step training mix is the same
+        # program at T=1 (`mix` receives the already-α-scaled weight row —
+        # a [1, M] stream), and the chain forms scale the raw flags by α
+        # exactly like gossip_mix's caller does, so step/multi_step/
+        # masked-multi_step are the same arithmetic at every entry point.
+        def mix(x, w, alive=None):
+            return perm_gossip_run(x, w[None, :], perms_i32, partnered,
+                                   alive=alive, **kernel_kwargs)
+
+        def multi_step(flat, carry, flags):
+            return perm_gossip_run(flat, alpha * flags, perms_i32,
+                                   partnered, **kernel_kwargs), carry
+
+        def multi_step_masked(flat, carry, flags, alive):
+            return perm_gossip_run(flat, alpha * flags, perms_i32,
+                                   partnered, alive=alive,
+                                   **kernel_kwargs), carry
+
     elif backend == "shard_map":
         if mesh is None:
             raise ValueError("shard_map backend needs a mesh")
@@ -192,5 +278,5 @@ def make_decen(
     wire_tag = "" if wire is None else f",wire={jnp.dtype(wire).name}"
     return Communicator(
         name=f"decen[{backend}{wire_tag}]", init=init, step=step,
-        multi_step=multi_step,
+        multi_step=multi_step, multi_step_masked=multi_step_masked,
     )

@@ -35,7 +35,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
 __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
-           "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "V4_KINDS",
+           "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "V4_KINDS", "V5_KINDS",
            "KIND_MIN_VERSION", "REQUIRED_FIELDS",
            "make_event", "validate_event", "Journal", "read_journal",
            "read_journal_tail", "resolve_journal_path", "latest_per_epoch",
@@ -48,10 +48,15 @@ __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
 #: files under ``health/``) and ``anomaly`` (a streaming detector's verdict
 #: with an attributed cause).  v4 (ISSUE 11) adds ``attribution`` — the
 #: link-level cost estimator's per-matching seconds fit (obs.attribution).
-#: Every v1/v2/v3 event validates verbatim under the v4 reader — pre-bump
-#: journals stay first-class sources.
-SCHEMA_VERSION = 4
-ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4})
+#: v5 (ISSUE 13) adds ``backend`` — the gossip-backend selection record
+#: ``gossip_backend="auto"`` resolves through (plan.cost
+#: choose_gossip_backend: chosen backend, per-backend byte models, the
+#: measured-vs-ceiling gate inputs), journaled so drift replay can score
+#: the choice against what the run measured.  Every pre-bump event
+#: validates verbatim under the v5 reader — old journals stay first-class
+#: sources.
+SCHEMA_VERSION = 5
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5})
 
 #: Every kind a journal may contain.  The five fault kinds keep their
 #: historical ``faults.json`` names so the view stays a pure filter.
@@ -74,15 +79,19 @@ V3_KINDS = frozenset({"heartbeat", "anomaly"})
 #: per-epoch comm seconds against the reconstructed activation design
 #: matrix, with its identifiability verdict (obs.attribution).
 V4_KINDS = frozenset({"attribution"})
+#: Kinds introduced by schema v5 (ISSUE 13) — ``backend`` carries one
+#: gossip-backend auto-selection record (requested/chosen/reason + the
+#: per-backend stream-byte entries and gate inputs from plan.cost).
+V5_KINDS = frozenset({"backend"})
 #: Minimum envelope version per kind — the generalized "a vK kind claiming
 #: an earlier v is a lying envelope" rule.
 KIND_MIN_VERSION: Dict[str, int] = {
     **{k: 2 for k in V2_KINDS}, **{k: 3 for k in V3_KINDS},
-    **{k: 4 for k in V4_KINDS}}
+    **{k: 4 for k in V4_KINDS}, **{k: 5 for k in V5_KINDS}}
 EVENT_KINDS = frozenset({
     "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
     "retrace", "bench",
-}) | FAULT_KINDS | V2_KINDS | V3_KINDS | V4_KINDS
+}) | FAULT_KINDS | V2_KINDS | V3_KINDS | V4_KINDS | V5_KINDS
 
 #: Kind-specific payload keys an event must carry to validate.  Kinds not
 #: listed need only the envelope (v / kind / t).
@@ -131,6 +140,10 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     "attribution": frozenset({"epochs_used", "matchings", "identifiable",
                               "base_seconds", "per_matching_seconds",
                               "source"}),
+    # v5 (ISSUE 13): one per gossip-backend resolution (communicator.decen
+    # resolve_gossip_backend) — what `auto` chose and why, with the
+    # planner's per-backend byte models when the selection actually ran
+    "backend": frozenset({"requested", "chosen", "reason"}),
 }
 
 

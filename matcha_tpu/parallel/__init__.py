@@ -17,6 +17,7 @@ from .gossip import (
     gossip_mix_skip,
     gossip_mix_folded,
     masked_laplacians,
+    matching_wire_bytes,
     resolve_wire_dtype,
     shard_map_gossip_fn,
 )
@@ -27,6 +28,8 @@ from .pallas_gossip import (
     canonical_chunk,
     compose_mixing_stack,
     fused_gossip_run,
+    involution_tables,
+    perm_gossip_run,
 )
 
 __all__ = [
@@ -48,9 +51,12 @@ __all__ = [
     "gossip_mix_dense",
     "gossip_mix_folded",
     "gossip_mix_skip",
+    "involution_tables",
     "masked_allreduce_mean",
     "masked_laplacians",
     "masked_mean_rows",
+    "matching_wire_bytes",
+    "perm_gossip_run",
     "replicated",
     "resolve_wire_dtype",
     "shard_map_gossip_fn",

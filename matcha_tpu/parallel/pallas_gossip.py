@@ -20,20 +20,57 @@ is step-for-step identical to the scan over ``gossip_mix_dense`` (f32
 accumulation, state cast to the wire dtype after every step), so intermediate
 iterates match the per-step backend; only their HBM materialization is
 elided.
+
+The permutation-form backend (``perm_gossip_run``)
+--------------------------------------------------
+The fused kernel above still *streams* the dense ``[T, N, N]`` W stack —
+the dominant HBM term of its roofline once the state is resident.  But
+``W_t = I − α·Σ_j flag[t,j]·L_j`` over perfect matchings is structurally a
+sum of **static involutions**: per row,
+
+    (W_t x)_i = x_i + Σ_j α·flag[t,j]·(x_{π_j(i)} − x_i)
+
+with the ``π_j`` trace-time constants (fixed points map to themselves, so
+their delta is exactly zero).  ``perm_gossip_run`` applies each step as M
+in-VMEM row gathers + weighted adds on the VPU and streams only the
+``[T, M]`` weight array from HBM — ~``N²·wire_bytes / (M·4)`` ≈ 2,000×
+less per-step traffic than the W stack at the north-star shape
+(``benchmarks/perm_probe.py`` measured the hardware question; this is the
+production form it graduated into).  It is also the only representable
+form in the 10k+-virtual-worker regime, where an ``[N, N]`` matrix —
+never mind a ``[T, N, N]`` stack — does not fit anything.
+
+Contracts (all pinned by ``tests/test_perm_backend.py``): f32-exact parity
+with the :func:`~matcha_tpu.parallel.gossip.gossip_mix` gather oracle,
+alive-mask composition through per-edge ``alive_i·alive_{π_j(i)}`` gates
+(realized mixing stays doubly stochastic over survivors), bf16 wire with
+f32 accumulation via the ``resolve_wire_dtype`` seam, and an
+``interpret=True`` path so the whole backend runs on the CPU tier-1 mesh.
+Involution tables enter through exactly one seam —
+:func:`involution_tables` — which validates ``π∘π = id`` at build time
+(the runtime half of the GL101 static proof).
 """
 
 from __future__ import annotations
 
 import functools
+import operator
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from .gossip import mxu_precision
+from .gossip import mxu_precision, resolve_wire_dtype
 
-__all__ = ["build_mixing_stack", "canonical_chunk", "compose_mixing_stack", "fused_gossip_run"]
+__all__ = [
+    "build_mixing_stack",
+    "canonical_chunk",
+    "compose_mixing_stack",
+    "fused_gossip_run",
+    "involution_tables",
+    "perm_gossip_run",
+]
 
 
 def build_mixing_stack(
@@ -57,9 +94,11 @@ def build_mixing_stack(
 def canonical_chunk(chunk: int) -> int:
     """The chunk size compose_mixing_stack actually executes: powers of two
     (pairwise doubling); values ≤ 1 disable composition."""
-    # graftlint: disable=GL002 — chunk rides static_argnames: a trace-time
-    # python int by design, never a tracer
-    chunk = int(chunk)
+    # operator.index, not int(): chunk rides static_argnames (a trace-time
+    # python int by design) and __index__ rejects floats and tracers loudly
+    # instead of silently concretizing — the honest spelling of "this must
+    # already be an int", and GL002-clean at the source
+    chunk = operator.index(chunk)
     return chunk if chunk <= 1 else 1 << (chunk - 1).bit_length()
 
 
@@ -175,8 +214,9 @@ def fused_gossip_run(
     if t_steps == 0:
         return x
     block_d = min(block_d, d)
-    # graftlint: disable=GL002 — w_window rides static_argnames (trace-time)
-    w_window = max(1, min(int(w_window), t_steps))
+    # operator.index: w_window rides static_argnames (trace-time int);
+    # see canonical_chunk — rejects tracers/floats instead of concretizing
+    w_window = max(1, min(operator.index(w_window), t_steps))
     pad = (-t_steps) % w_window
     if pad:
         eye = jnp.broadcast_to(
@@ -194,3 +234,198 @@ def fused_gossip_run(
         out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
         interpret=interpret,
     )(x, mixing_stack)
+
+
+# ---------------------------------------------------------------------------
+# Permutation-form backend: stream the [T, M] weights, not the W stack
+# ---------------------------------------------------------------------------
+
+def involution_tables(perms) -> tuple[np.ndarray, np.ndarray]:
+    """THE table seam of the perm backend: validate + normalize matchings.
+
+    ``perms``: ``int[M, N]`` — one total involution per matching (partner
+    index, or self for unmatched slots), exactly ``Schedule.perms``.
+    Returns ``(perms int32[M, N], partnered f32[M, N])`` with
+    ``partnered[j, i] = 1`` iff slot ``i`` has a partner in matching ``j``.
+
+    Every row is checked to be a *total involution* (``π[π[i]] == i`` with
+    in-range entries) and a :class:`ValueError` names the first offender
+    otherwise.  This is the runtime half of the GL101 contract: static
+    tables are proven parametrically by graftverify; schedule-built tables
+    are routed through this validator, so a gather against a non-involution
+    — which would silently double- or zero-weight rows, the same corruption
+    class as a one-sided ``ppermute`` — cannot reach the kernel either way.
+    """
+    p = np.asarray(perms)
+    if p.ndim != 2:
+        raise ValueError(f"perms must be [M, N], got shape {p.shape}")
+    m, n = p.shape
+    if not np.issubdtype(p.dtype, np.integer):
+        raise ValueError(f"perms must be integer partner indices, "
+                         f"got dtype {p.dtype}")
+    if m and ((p < 0).any() or (p >= n).any()):
+        j = int(np.argwhere((p < 0) | (p >= n))[0][0])
+        raise ValueError(f"matching {j}: partner index out of range [0, {n})")
+    rows = np.arange(n)
+    for j in range(m):
+        if not np.array_equal(p[j][p[j]], rows):
+            bad = int(np.argwhere(p[j][p[j]] != rows)[0][0])
+            raise ValueError(
+                f"matching {j} is not an involution: "
+                f"π(π({bad})) = {int(p[j][p[j]][bad])} != {bad} — a matching "
+                f"must pair slots symmetrically (fixed points map to self)")
+    return p.astype(np.int32), (p != rows[None, :]).astype(np.float32)
+
+
+def _make_perm_kernel(w_window: int, num_matchings: int, wire):
+    """Kernel body: one VMEM-resident state block × a window of steps.
+
+    Per step ``k`` of the window, with ``w = w_ref[k]`` the α-scaled flag
+    row: quantize the resident block to the wire dtype once, then for every
+    matching gather the partner rows (``pi_ref[j]`` is a static involution,
+    so the gather IS the exchange) and accumulate
+    ``w_j · gate_j · (x[π_j] − x)`` in f32.  The accumulation order and the
+    per-edge gate algebra replicate ``gossip_mix`` exactly, so the f32 path
+    is bitwise the gather oracle (tests pin it); fixed points contribute a
+    delta of exactly zero, which is why no degree bookkeeping appears.
+    """
+
+    def _kernel(x_ref, w_ref, pi_ref, gate_ref, o_ref):
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _():
+            o_ref[...] = x_ref[...]
+
+        w_win = w_ref[...]  # [w_window, M] — one tiny read per visit
+
+        def step(k, carry):
+            cur = o_ref[...]
+            curf = cur.astype(jnp.float32)
+            # wire image: quantized ONCE per step, read by both gather
+            # endpoints — edge-pairwise cancellation (exact worker-mean
+            # preservation) survives the narrow wire, same proof as
+            # gossip_mix.  f32 wire keeps the state untouched.
+            xw = curf if wire is None else cur.astype(wire).astype(jnp.float32)
+            acc = jnp.zeros_like(curf)
+            for j in range(num_matchings):
+                # the row gather is the matching exchange: partner rows of
+                # this static involution, VMEM-local sublane movement
+                delta = jnp.take(xw, pi_ref[j], axis=0) - xw
+                acc = acc + (w_win[k, j] * gate_ref[j])[:, None] * delta
+            o_ref[...] = (curf + acc).astype(o_ref.dtype)
+            return carry
+
+        # fori_loop, not a python unroll: the step body is identical per k
+        # (only the dynamic weight-row index moves), and unrolling it made
+        # interpret-mode compile time blow up superlinearly past ~5 steps
+        # — a w_window=8 window cost 38 s of XLA CPU compile unrolled,
+        # <2 s looped, with the loop trip count a trace-time constant
+        jax.lax.fori_loop(0, w_window, step, 0)
+
+    return _kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_d", "w_window", "wire_dtype", "interpret"))
+def perm_gossip_run(
+    x: jax.Array,
+    weights: jax.Array,
+    perms: jax.Array,
+    partnered: jax.Array,
+    *,
+    alive: jax.Array | None = None,
+    block_d: int = 2048,
+    w_window: int = 1,
+    wire_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Apply ``T`` gossip steps in permutation form, streaming only weights.
+
+    ``x``: ``[N, D]`` worker state.  ``weights``: ``f32[T, M]`` — the
+    α-scaled activation flags (``alpha * flags``); this is the ONLY per-step
+    operand that streams from HBM (``M·4`` bytes per step-window visit vs
+    the fused kernel's ``N²·wire_bytes``).  ``perms``/``partnered``: the
+    ``[M, N]`` static involution tables from :func:`involution_tables`,
+    replicated into VMEM once per D-block and reused across the whole
+    window.  The grid tiles (D-blocks × step-windows) with the step axis
+    fastest, so each ``[N, block_d]`` state block is read once, mixed for
+    all T steps in VMEM, and written once — the structure that removes the
+    fused kernel's dominant W-stack stream.
+
+    ``alive``: optional traced ``f32[N]`` survivor mask.  Each matching's
+    per-slot gate becomes ``partnered_j · alive · alive[π_j]`` (computed
+    in-graph — ``[M, N]``, negligible), so an edge is realized only when
+    both endpoints live and the realized mixing stays doubly stochastic
+    over survivors, identically to every other backend (``parallel.gossip``
+    module docstring; non-finite dead rows are sealed upstream by the
+    resilience runtime, the same NaN contract as ``gossip_mix``).  The
+    mask is a plain traced input: membership changes never retrace.
+
+    ``wire_dtype`` — resolved through
+    :func:`~matcha_tpu.parallel.gossip.resolve_wire_dtype`, the one GL004
+    quantization seam every exchange narrows through:
+    the gathered operand is quantized once per step before the exchange;
+    accumulation is always f32 regardless of state dtype.  ``w_window``
+    steps are applied per grid visit (front-padded with zero-weight rows —
+    exact identities — when ``T % w_window != 0``); like the fused kernel's
+    window it changes DMA granularity and grid size, never arithmetic:
+    the window runs as a ``fori_loop`` over one compiled step body (only
+    the weight-row index moves), so every window size is *bitwise* the
+    same chain — and compile time stays flat instead of blowing up with
+    an unrolled body.
+    ``interpret=True`` runs the Pallas interpreter — the CPU tier-1 path.
+
+    Parity contract (pinned by ``tests/test_perm_backend.py``): bitwise
+    equal in f32 — masked or not, any wire — to a *compiled* ``lax.scan``
+    over :func:`~matcha_tpu.parallel.gossip.gossip_mix` (the gather
+    oracle; an eager op-by-op chain differs from any compiled form at the
+    1-ulp FMA-contraction scale, which is XLA, not this kernel).
+    """
+    n, d = x.shape
+    if weights.ndim != 2:
+        raise ValueError(f"weights must be [T, M], got {weights.shape}")
+    t_steps, m = weights.shape
+    if perms.shape != (m, n) or partnered.shape != (m, n):
+        raise ValueError(
+            f"tables {perms.shape}/{partnered.shape} incompatible with "
+            f"weights {weights.shape} and state {x.shape}")
+    if t_steps == 0 or m == 0:
+        return x
+    wire = resolve_wire_dtype(wire_dtype)
+    block_d = min(operator.index(block_d), d)
+    # operator.index: static_argnames int, see canonical_chunk
+    w_window = max(1, min(operator.index(w_window), t_steps))
+    weights = weights.astype(jnp.float32)
+    pad = (-t_steps) % w_window
+    if pad:
+        # front-pad with zero weights: an all-zero row is the identity
+        # step bitwise (0·delta accumulates nothing; the wire quantization
+        # it computes is discarded), so padding never perturbs the chain
+        weights = jnp.concatenate(
+            [jnp.zeros((pad, m), jnp.float32), weights])
+    gate = jnp.asarray(partnered, jnp.float32)
+    if alive is not None:
+        av = jnp.asarray(alive, jnp.float32)
+        # both-endpoints edge gate, folded into the static partnered mask
+        # outside the kernel ([M, N] — tiny next to the state); 0/1 alive
+        # keeps the product algebra exact, so masked parity with the
+        # gather oracle stays bitwise in f32
+        # graftlint: disable=GL001 — weights, not values: the alive
+        # product scales each edge's *weight*; non-finite rows are sealed
+        # upstream (resilience.runtime.gossip_quarantined)
+        gate = gate * av[None, :] * av[jnp.asarray(perms)]
+    grid = (pl.cdiv(d, block_d), (t_steps + pad) // w_window)
+    return pl.pallas_call(
+        _make_perm_kernel(w_window, m, wire),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n, block_d), lambda i, t: (0, i)),
+            pl.BlockSpec((w_window, m), lambda i, t: (t, 0)),
+            pl.BlockSpec((m, n), lambda i, t: (0, 0)),
+            pl.BlockSpec((m, n), lambda i, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n, block_d), lambda i, t: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        interpret=interpret,
+    )(x, weights, jnp.asarray(perms, jnp.int32), gate)

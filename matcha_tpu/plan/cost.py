@@ -37,9 +37,13 @@ from ..topology import matchings_to_perms
 
 __all__ = [
     "CostModel",
+    "GOSSIP_BACKEND_GATE",
+    "PERM_FORCED_WORKERS",
     "matching_comm_units",
     "expected_comm_units",
     "calibrate_cost_model",
+    "choose_gossip_backend",
+    "gossip_backend_entries",
     "load_measured_comm_times",
     "load_measured_link_costs",
 ]
@@ -193,6 +197,132 @@ def calibrate_cost_model(
     c0, c1 = max(float(c0), 0.0), max(float(c1), 0.0)
     return CostModel(base_step_s=c0, per_hop_s=c1, source=source,
                      fit=provenance)
+
+
+# ---------------------------------------------------------------------------
+# Per-gossip-backend cost entries + the perm-vs-fused selection gate
+# ---------------------------------------------------------------------------
+
+#: Measured-vs-ceiling ratio above which the dense/fused formulation has no
+#: implementation headroom left and only a *structural* change (streaming
+#: the [T, M] flags instead of the [T, N, N] W stack) can buy more speed.
+#: PR 8's roofline put the fused kernel at ~91% of its MXU ceiling — the
+#: observation this gate encodes (`obs_tpu.py roofline --backend fused`).
+GOSSIP_BACKEND_GATE = 0.85
+
+#: Worker count beyond which the dense W-stack is treated as
+#: unrepresentable regardless of any measurement: an [N, N] f32 matrix at
+#: 4096 workers is 64 MB *per step of the stack* — the 10k+-virtual-worker
+#: regime only the permutation form can express (ROADMAP: oversubscribed
+#: fleet emulator).
+PERM_FORCED_WORKERS = 4096
+
+
+def gossip_backend_entries(n: int, num_matchings: int,
+                           dim: Optional[int] = None,
+                           wire_dtype=None, block_d: int = 2048) -> dict:
+    """Per-backend streamed-operand HBM bytes for one gossip step of the
+    fused multi-step chain — the planner's ledger the backend choice reads.
+
+    The state block is VMEM-resident in both kernels, so the *streamed*
+    per-step operand is what separates them: the fused kernel re-reads
+    ``N²·wire_bytes`` of W per D-block visit, the permutation kernel reads
+    ``M·4`` bytes of flag row (its involution tables are replicated once,
+    not per step).  With ``dim`` the entries are absolute bytes/step
+    (``ceil(D/block_d)`` visits); without it they are per-D-block-visit
+    units — the fused/perm *ratio* is D-independent either way.  The dense
+    per-step path (training regime: state streams every step) rides along
+    for completeness when ``dim`` is known.
+    """
+    from ..parallel.gossip import resolve_wire_dtype as _resolve
+
+    wire = _resolve(wire_dtype)
+    wire_bytes = 4 if wire is None else np.dtype(wire).itemsize
+    visits = 1 if dim is None else -(-int(dim) // int(block_d))
+    entries = {
+        "fused": {"stream_bytes_per_step": float(visits * n * n * wire_bytes),
+                  "streamed": "[T, N, N] mixing stack"},
+        "perm": {"stream_bytes_per_step": float(visits * num_matchings * 4),
+                 "streamed": "[T, M] flag array",
+                 "table_bytes": float(num_matchings * n * (4 + 4))},
+    }
+    if dim is not None:
+        entries["dense"] = {
+            "stream_bytes_per_step": float((2.0 * n * dim + n * n)
+                                           * wire_bytes),
+            "streamed": "full [N, D] state + W_t",
+        }
+    return entries
+
+
+def choose_gossip_backend(
+    n: int,
+    num_matchings: int,
+    dim: Optional[int] = None,
+    wire_dtype=None,
+    block_d: int = 2048,
+    budget: Optional[float] = None,
+    topology: Optional[str] = None,
+    measured_vs_ceiling: Optional[float] = None,
+    gate: float = GOSSIP_BACKEND_GATE,
+) -> dict:
+    """Resolve ``gossip_backend="auto"`` on a single chip: perm vs fused.
+
+    The decision is **gated on evidence**, not on the byte model alone: the
+    flag stream is always ~2000× smaller than the W stack, but the fused
+    kernel is MXU-bound, so less traffic only wins once the dense form has
+    no headroom left.  Three-step rule, in order:
+
+    1. ``n >= PERM_FORCED_WORKERS`` → ``perm`` (the W stack is
+       unrepresentable; no measurement needed).
+    2. ``measured_vs_ceiling >= gate`` (the roofline's measured/ceiling
+       ratio for the dense/fused formulation — ``obs_tpu.py roofline``
+       extracts it) → ``perm``: the structural lever is the only one left.
+    3. otherwise → ``dense`` (the committed per-step training path; the
+       fused multi-step chain rides the same W-stack form).  With no
+       measurement at all this is always the answer — ``auto`` never
+       promotes an unmeasured kernel, the same discipline as the probe's
+       correctness-gated ratio.
+
+    Returns the full decision record (chosen backend, reason, both byte
+    models, the stream ratio, and the gate inputs) so the caller can
+    journal it — ``obs_tpu.py drift`` then scores the choice against what
+    the run actually measured.
+    """
+    entries = gossip_backend_entries(n, num_matchings, dim=dim,
+                                     wire_dtype=wire_dtype, block_d=block_d)
+    perm_b = entries["perm"]["stream_bytes_per_step"]
+    fused_b = entries["fused"]["stream_bytes_per_step"]
+    ratio = fused_b / max(perm_b, 1.0)
+    record = {
+        "requested": "auto",
+        "n": int(n), "matchings": int(num_matchings),
+        "dim": None if dim is None else int(dim),
+        "budget": budget, "topology": topology,
+        "entries": entries,
+        "stream_ratio_fused_over_perm": round(float(ratio), 2),
+        "measured_vs_ceiling": measured_vs_ceiling,
+        "gate": float(gate),
+    }
+    if n >= PERM_FORCED_WORKERS:
+        record.update(chosen="perm", reason=(
+            f"N={n} >= {PERM_FORCED_WORKERS}: the [N, N] W-stack form is "
+            f"unrepresentable at this scale; only the flag-stream "
+            f"permutation form remains"))
+    elif measured_vs_ceiling is not None and measured_vs_ceiling >= gate:
+        record.update(chosen="perm", reason=(
+            f"measured/ceiling {measured_vs_ceiling:.2f} >= gate "
+            f"{gate:.2f}: the dense formulation is at its roofline, and "
+            f"the perm form streams {ratio:.0f}x fewer bytes/step"))
+    else:
+        why = ("no measured-vs-ceiling ratio supplied"
+               if measured_vs_ceiling is None else
+               f"measured/ceiling {measured_vs_ceiling:.2f} < gate "
+               f"{gate:.2f}: headroom remains in the dense form")
+        record.update(chosen="dense", reason=(
+            f"{why}; auto keeps the committed W-stack path (pass "
+            f"gossip_backend='perm' to force the flag-stream kernel)"))
+    return record
 
 
 def load_measured_link_costs(data) -> Tuple[dict, str]:

@@ -72,9 +72,24 @@ class TrainConfig:
     # addresses the compressed-consensus cold start that leaves 64-worker
     # top-k-10% runs far behind their uncompressed control early on.
     compress_warmup_epochs: int = 0
-    gossip_backend: str = "auto"  # fused|dense|gather|skip|shard_map|auto
-    gossip_block_d: Optional[int] = None  # fused kernel D-block (None = default)
-    gossip_w_window: int = 1  # fused kernel W_t per D-block visit (exact)
+    # gossip backend: dense (MXU matmul/step), fused (Pallas W-stack
+    # multi-step kernel), perm (permutation-form Pallas kernel — streams
+    # only the [T, M] flag array, the 10k+-worker form), gather, skip,
+    # shard_map, or auto (shard_map on a real mesh; single-chip the
+    # perm-vs-dense choice runs through plan.cost.choose_gossip_backend
+    # and the decision is journaled as a `backend` event)
+    gossip_backend: str = "auto"
+    gossip_block_d: Optional[int] = None  # fused/perm D-block (None = default)
+    gossip_w_window: int = 1  # fused/perm steps per D-block visit (exact)
+    # the auto gate's measured input: the dense-formulation
+    # measured-vs-ceiling ratio from `obs_tpu.py roofline` (the
+    # measured_vs_ceiling field of a prior round's report).  None = no
+    # measurement, so auto never promotes perm below the N>=4096
+    # representability wall; feeding ~0.9 here (e.g. the committed r4
+    # fused rate vs the v5e ceiling) is how an operator closes the
+    # roofline->selection loop for a real run.  Journaled in the
+    # `backend` decision event either way.
+    gossip_measured_vs_ceiling: Optional[float] = None
     # overlapped gossip pipeline (DESIGN.md §11): "1step" issues each step's
     # exchange via begin_mix and consumes it at the next step, so XLA can
     # hide ICI traffic under the next forward/backward; "off" is the eager
@@ -234,6 +249,12 @@ class TrainConfig:
         if self.wire_dtype not in ("f32", "bf16"):
             raise ValueError(
                 f"wire_dtype must be 'f32' or 'bf16', got {self.wire_dtype!r}")
+        if self.gossip_measured_vs_ceiling is not None \
+                and not self.gossip_measured_vs_ceiling >= 0:
+            raise ValueError(
+                f"gossip_measured_vs_ceiling must be >= 0 (a "
+                f"measured/ceiling ratio), got "
+                f"{self.gossip_measured_vs_ceiling}")
         if self.compress_warmup_epochs < 0:
             raise ValueError("compress_warmup_epochs must be >= 0")
         if self.compress_warmup_epochs and self.communicator != "choco":

@@ -223,11 +223,28 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if mesh is not None and (mesh.size == 1 or config.num_workers % mesh.size):
         mesh = None  # single chip or non-divisible fold: dense backend (auto)
 
+    # gossip-backend resolution (ISSUE 13): resolve `auto` ONCE, here, via
+    # the planner's per-backend cost ledger, and hand the concrete backend
+    # to every _make_comm rebuild — the decision record is journaled next
+    # to run_start (a v5 `backend` event) so drift replay can score the
+    # choice against what the run measured.  Non-decen communicators have
+    # no gossip backend to resolve; their record says a pass-through.
+    backend_decision = None
+    gossip_backend = config.gossip_backend
+    if config.communicator == "decen":
+        from ..communicator.decen import resolve_gossip_backend
+
+        backend_decision = resolve_gossip_backend(
+            schedule, mesh, requested=config.gossip_backend,
+            wire_dtype=config.wire_dtype,
+            measured_vs_ceiling=config.gossip_measured_vs_ceiling)
+        gossip_backend = backend_decision["chosen"]
+
     def _make_comm(ratio: float):
         return select_communicator(
             config.communicator, schedule, mesh=mesh,
             ratio=ratio, consensus_lr=config.consensus_lr,
-            backend=config.gossip_backend, compressor=config.compressor,
+            backend=gossip_backend, compressor=config.compressor,
             seed=config.seed, block_d=config.gossip_block_d,
             w_window=config.gossip_w_window, wire_dtype=config.wire_dtype,
         )
@@ -572,6 +589,11 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         recorder.log_event("run_start",
                            config=_config_snapshot(config),
                            predicted=predicted or {})
+    if backend_decision is not None:
+        # the auto-resolution record (or the explicit pass-through): what
+        # backend compiled and why — journaled unconditionally so a
+        # questionable `auto` choice is always auditable post-hoc
+        recorder.log_event("backend", **backend_decision)
     rng = jax.random.PRNGKey(config.seed)
     history: List[Dict] = []
 

@@ -33,15 +33,19 @@ Commands
 
 Performance observability (DESIGN.md §15):
 
-``roofline [--workers N] [--dim D | --model M] [--chip C] [--measured R |
---source SRC] [--md PATH]``
-    The automatic roofline: compile the dense per-step gossip program at
-    the requested shape, extract FLOPs/HBM-bytes from the compiled cost
-    analysis, and emit compute-bound / HBM-bound steps/s ceilings against
-    the pinned chip peaks (CPU gets explicit provisional placeholders) —
-    machine-checking benchmarks/ROOFLINE.md.  ``--measured`` (or a bench
-    record via ``--source``) adds the measured-vs-ceiling ratio the
-    Pallas-promotion gate reads.  Exit 1 when any ceiling is non-finite.
+``roofline [--backend dense|fused|perm|both] [--workers N] [--dim D |
+--model M] [--chip C] [--measured R | --source SRC] [--md PATH]``
+    The automatic roofline: compile the selected gossip program at the
+    requested shape — the dense per-step matmul, the fused W-stack chain,
+    the permutation-form flag-stream chain, or the perm-vs-fused
+    comparison (``both``) — extract FLOPs/HBM-bytes from the compiled
+    cost analysis, and emit compute-bound / HBM-bound steps/s ceilings
+    against the pinned chip peaks (CPU gets explicit provisional
+    placeholders) — machine-checking benchmarks/ROOFLINE.md.
+    ``--measured`` (or a bench record via ``--source``) adds the
+    measured-vs-ceiling ratio the backend-promotion gate reads; the
+    report names which backend's ceiling the ratio divides by.  Exit 1
+    when any requested ceiling is non-finite (perm included).
 
 ``capacity [--dim D | --model M] [--workers N,N] [--chip C] [--md PATH]``
     Re-derive the DESIGN.md §9 HBM capacity table from the compiled
@@ -192,12 +196,15 @@ def _resolve_dim(args) -> int:
 
 
 def _resolve_measured(args):
-    """Measured steps/s: explicit ``--measured``, or the first rate row a
-    ``--source`` (bench journal / BENCH_r*.json / run dir) yields."""
+    """``(steps_per_sec, backend)`` — explicit ``--measured`` (backend =
+    the ``--measured-backend`` flag), or the first rate row a ``--source``
+    (bench journal / BENCH_r*.json / run dir) yields, with the record's
+    own ``backend`` field carried along so the ratio is attributed to the
+    kernel that was actually measured, never assumed."""
     if args.measured is not None:
-        return float(args.measured)
+        return float(args.measured), getattr(args, "measured_backend", None)
     if not args.source:
-        return None
+        return None, None
     from matcha_tpu.obs.report import compare_sources
 
     rows, problems = compare_sources([args.source])
@@ -205,7 +212,7 @@ def _resolve_measured(args):
         print(f"# {p}", file=sys.stderr)
     for row in rows:
         if row.get("value") and row.get("unit") == "gossip_steps_per_sec":
-            return float(row["value"])
+            return float(row["value"]), row.get("backend")
     # name what WAS there and what would have worked — "no record" alone
     # sends the operator diffing JSON shapes by hand
     found = sorted({str(r.get("unit")) for r in rows}) or ["nothing"]
@@ -215,13 +222,33 @@ def _resolve_measured(args):
           f"unit=gossip_steps_per_sec, a BENCH_r*.json driver capture "
           f"(record/parsed/tail wrappers ok), or a bench_live_r*.json "
           f"record", file=sys.stderr)
+    return None, None
+
+
+def _normalize_measured_backend(label):
+    """Map a bench record's ``backend`` field onto the roofline backend
+    vocabulary: the cpu-fallback provisional is a dense f32 measurement;
+    unknown labels return None (unattributable)."""
+    if label is None:
+        return None
+    label = str(label)
+    for key in ("perm", "fused", "dense"):
+        if key in label:
+            return key
+    if "cpu-fallback" in label:
+        return "dense"
     return None
 
 
 def cmd_roofline(args) -> int:
     import math
 
-    from matcha_tpu.obs.costs import render_roofline_markdown, roofline_report
+    from matcha_tpu.obs.costs import (
+        render_roofline_compare_markdown,
+        render_roofline_markdown,
+        roofline_compare,
+        roofline_report,
+    )
     from matcha_tpu.topology import decompose, graph_size, make_graph, \
         select_graph
 
@@ -232,27 +259,74 @@ def cmd_roofline(args) -> int:
         n = args.workers
         decomposed = decompose(make_graph(args.topology, n, seed=1), n, seed=1)
     dim = _resolve_dim(args)
-    report = roofline_report(n, dim, decomposed, wire_dtype=args.wire_dtype,
-                             chip=args.chip,
-                             measured_steps_per_sec=_resolve_measured(args))
-    md = render_roofline_markdown(report, source=args.source or "")
+    measured, measured_from = _resolve_measured(args)
+    # attribute the measured rate to the kernel that produced it: the
+    # explicit --measured-backend flag wins, else the source record's own
+    # `backend` field — a rate must never be quoted against another
+    # backend's ceiling (the denominator mis-citation
+    # measured_vs_ceiling_backend exists to prevent)
+    m_backend = args.measured_backend or _normalize_measured_backend(
+        measured_from)
+
+    def finite(rep) -> bool:
+        return all(math.isfinite(rep[k]) and rep[k] > 0 for k in
+                   ("flops_per_step", "hbm_bytes_per_step",
+                    "compute_bound_steps_per_sec",
+                    "hbm_bound_steps_per_sec"))
+
+    if args.backend == "both":
+        if measured is not None and m_backend not in ("fused", "perm"):
+            print(f"# measured rate came from backend "
+                  f"{measured_from!r} — not a chain kernel; comparison "
+                  f"emitted without a measured row (pass "
+                  f"--measured-backend to override)", file=sys.stderr)
+            measured = None
+        report = roofline_compare(n, dim, decomposed,
+                                  wire_dtype=args.wire_dtype,
+                                  chip=args.chip,
+                                  measured_steps_per_sec=measured,
+                                  measured_backend=m_backend or "perm")
+        md = render_roofline_compare_markdown(report,
+                                              source=args.source or "")
+        # a non-finite PERM ceiling fails exactly like the historical
+        # dense path: the comparison is only evidence when both sides
+        # extracted real numbers
+        ok = finite(report["fused"]) and finite(report["perm"])
+        journal_payload = {"roofline_compare": report,
+                           "unit": "roofline_compare"}
+    else:
+        report = roofline_report(n, dim, decomposed,
+                                 wire_dtype=args.wire_dtype,
+                                 chip=args.chip,
+                                 measured_steps_per_sec=measured,
+                                 backend=args.backend)
+        if measured is not None and m_backend is not None:
+            # origin of the rate, recorded next to the denominator: a
+            # fused rate against the dense report is the intended
+            # formulation-gate pairing (same 2·N²·D compute bound), but
+            # the record must say so rather than imply a same-backend
+            # measurement
+            report["measured_backend"] = m_backend
+            if m_backend != args.backend:
+                print(f"# note: measured rate comes from the "
+                      f"{m_backend!r} backend; this report's ceilings "
+                      f"price {args.backend!r} (the record carries both "
+                      f"labels)", file=sys.stderr)
+        md = render_roofline_markdown(report, source=args.source or "")
+        ok = finite(report)
+        journal_payload = {"roofline": report, "unit": "roofline_report"}
     print(md)
     if args.md:
         with open(args.md, "w") as f:
             f.write(md)
         print(f"# markdown written to {args.md}", file=sys.stderr)
-    ok = all(math.isfinite(report[k]) and report[k] > 0 for k in
-             ("flops_per_step", "hbm_bytes_per_step",
-              "compute_bound_steps_per_sec", "hbm_bound_steps_per_sec"))
     if args.journal and ok:
         # gated on finiteness: a failed extraction must not write NaN
         # tokens (non-strict JSON) into a session journal the compare /
         # summary renderers will read later
         from matcha_tpu.obs import append_journal_record
 
-        append_journal_record(args.journal, "bench",
-                              record={"roofline": report,
-                                      "unit": "roofline_report"})
+        append_journal_record(args.journal, "bench", record=journal_payload)
     if not ok:
         print("obs_tpu: roofline produced non-finite ceilings (nothing "
               "journaled)", file=sys.stderr)
@@ -455,8 +529,26 @@ def main(argv=None) -> int:
                    help="zoo topology id instead of the generator")
     s.add_argument("--wire-dtype", default="bf16", choices=["f32", "bf16"],
                    dest="wire_dtype")
+    s.add_argument("--backend", default="dense",
+                   choices=["dense", "fused", "perm", "both"],
+                   help="whose program to price: the dense per-step matmul "
+                        "(historical default), the fused W-stack chain, "
+                        "the permutation-form flag-stream chain, or the "
+                        "perm-vs-fused comparison (exit 1 when any ceiling "
+                        "is non-finite, perm included)")
     s.add_argument("--measured", type=float, default=None,
                    help="measured steps/s for the vs-ceiling ratio")
+    s.add_argument("--measured-backend", default=None,
+                   choices=["dense", "fused", "perm"],
+                   dest="measured_backend",
+                   help="which backend produced the measured rate "
+                        "(default: the --source record's own `backend` "
+                        "field).  `--backend both` withholds the measured "
+                        "row for non-chain (dense/cpu-fallback) sources; "
+                        "single-backend reports always emit the ratio but "
+                        "record BOTH labels (measured_backend + "
+                        "measured_vs_ceiling_backend) and note "
+                        "cross-backend pairings")
     s.add_argument("--source", default=None,
                    help="bench journal / BENCH_r*.json / run dir to read "
                         "the measured rate from instead of --measured")

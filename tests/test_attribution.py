@@ -485,8 +485,10 @@ def test_cli_attribute_recovers_planted_and_writes_artifact(tmp_path):
     from matcha_tpu.analysis import lint_link_costs_data
 
     assert lint_link_costs_data(data, str(out)) == []
+    from matcha_tpu.obs.journal import SCHEMA_VERSION
+
     [event] = read_journal(str(side))
-    assert event["kind"] == "attribution" and event["v"] == 4
+    assert event["kind"] == "attribution" and event["v"] == SCHEMA_VERSION
     assert validate_event(event) == []
 
 

@@ -263,6 +263,106 @@ def test_gl101_suppression(tmp_path):
     assert vs == []
 
 
+# ============================================== GL101: involution tables
+# (ISSUE 13: the permutation-form gossip kernel's row-gather tables are the
+# same silent-corruption class as a one-sided ppermute — verified statically
+# where foldable, parametrically under bind hints, and accepted through the
+# involution_tables runtime-validator seam otherwise.)
+
+def test_gl101_fires_on_non_involution_literal(tmp_path):
+    vs = _lint(tmp_path, """
+        from matcha_tpu.parallel import perm_gossip_run
+
+        def f(x, w, gate):
+            return perm_gossip_run(x, w, [[1, 2, 0]], gate)
+    """)
+    assert _ids(vs) == ["GL101"]
+    assert "not an involution" in vs[0].message  # names the asymmetry
+
+
+def test_gl101_fires_on_broken_involution_under_binding(tmp_path):
+    # π(i) = (i + d) % n is an involution only when 2·d ≡ 0 (mod n):
+    # the d=1 binding must break the parametric proof and be named
+    vs = _lint(tmp_path, """
+        from matcha_tpu.parallel import perm_gossip_run
+
+        def f(x, w, gate, n, d):
+            # graftverify: bind n=4 d=1,2
+            tables = [[(i + d) % n for i in range(n)]]
+            return perm_gossip_run(x, w, tables, gate)
+    """)
+    assert _ids(vs) == ["GL101"]
+    assert "involution" in vs[0].message
+    assert "binding" in vs[0].message
+
+
+def test_gl101_silent_on_hinted_involution_and_pair_swap(tmp_path):
+    vs = _lint(tmp_path, """
+        from matcha_tpu.parallel import perm_gossip_run
+
+        def shifted(x, w, gate, n):
+            # the n/2 shift pairs i with its antipode: a real involution
+            # for every even binding
+            # graftverify: bind n=2,4,8
+            tables = [[(i + n // 2) % n for i in range(n)]]
+            return perm_gossip_run(x, w, tables, gate)
+
+        def literal(x, w, gate):
+            return perm_gossip_run(x, w, [[1, 0, 3, 2], [0, 2, 1, 3]],
+                                   gate)
+    """)
+    assert vs == []
+
+
+def test_gl101_accepts_involution_tables_seam(tmp_path):
+    # schedule-built tables are runtime values; routing them through the
+    # involution_tables validator (which raises on a non-involution) is
+    # the sanctioned seam — including tuple unpacking and closure use,
+    # the shape the production backend factory has
+    vs = _lint(tmp_path, """
+        from matcha_tpu.parallel import involution_tables, perm_gossip_run
+
+        def make(schedule):
+            pi, pr = involution_tables(schedule.perms)
+
+            def mix(x, w):
+                return perm_gossip_run(x, w, pi, pr)
+
+            return mix
+    """)
+    assert vs == []
+
+
+def test_gl101_fires_on_unvalidated_runtime_tables(tmp_path):
+    vs = _lint(tmp_path, """
+        import numpy as np
+        from matcha_tpu.parallel import perm_gossip_run
+
+        def f(x, w, gate, schedule):
+            pi = np.asarray(schedule.perms, np.int32)
+            return perm_gossip_run(x, w, pi, gate)
+    """)
+    assert _ids(vs) == ["GL101"]
+    assert "involution_tables" in vs[0].message  # the fix is the seam
+
+
+def test_involution_tables_validator_rejects_non_involution():
+    # the runtime half of the seam the static rule accepts: a 3-cycle
+    # must raise, a pair-swap stack must normalize
+    import numpy as np
+    import pytest as _pytest
+
+    from matcha_tpu.parallel import involution_tables
+
+    pi, pr = involution_tables(np.asarray([[1, 0, 2], [0, 2, 1]]))
+    assert pi.dtype == np.int32 and pr.dtype == np.float32
+    assert pr.tolist() == [[1.0, 1.0, 0.0], [0.0, 1.0, 1.0]]
+    with _pytest.raises(ValueError, match="not an involution"):
+        involution_tables(np.asarray([[1, 2, 0]]))
+    with _pytest.raises(ValueError, match="out of range"):
+        involution_tables(np.asarray([[3, 0, 1]]))
+
+
 # ===================================================================== GL102
 
 def test_gl102_fires_on_collective_in_divergent_branch(tmp_path):

@@ -86,16 +86,29 @@ def parse_args(argv=None) -> TrainConfig:
     p.add_argument("--centralized", action="store_true", help="AllReduce baseline")
     p.add_argument("--randomSeed", type=int, default=9001, dest="seed")
     p.add_argument("--backend", default="auto",
-                   help="gossip backend: fused|dense|gather|skip|shard_map|auto "
-                        "(skip = per-matching lax.cond; inactive matchings "
-                        "cost nothing, so budget < 1 buys real time; gather "
-                        "is a small-N debugging path — ~60x slower than "
-                        "dense/fused at N>=64 and warns there)")
+                   help="gossip backend: fused|dense|perm|gather|skip|"
+                        "shard_map|auto (perm = permutation-form Pallas "
+                        "kernel streaming only the [T, M] flags — the "
+                        "10k+-worker form; skip = per-matching lax.cond; "
+                        "inactive matchings cost nothing, so budget < 1 "
+                        "buys real time; gather is a small-N debugging "
+                        "path — ~60x slower than dense/fused at N>=64 and "
+                        "warns there; auto journals its perm-vs-dense "
+                        "decision as a `backend` event)")
     p.add_argument("--block-d", type=int, default=None, dest="block_d",
-                   help="fused-backend Pallas D-block size (default: kernel's)")
+                   help="fused/perm-backend Pallas D-block size "
+                        "(default: kernel's)")
     p.add_argument("--w-window", type=int, default=1, dest="w_window",
-                   help="fused-backend W_t steps per D-block VMEM visit "
+                   help="fused/perm-backend steps per D-block VMEM visit "
                         "(exact per-step arithmetic, amortizes grid overhead)")
+    p.add_argument("--gossip-measured-ratio", type=float, default=None,
+                   dest="gossip_measured_vs_ceiling",
+                   help="measured-vs-ceiling ratio from `obs_tpu.py "
+                        "roofline` fed to the --backend auto gate: >= 0.85 "
+                        "means the dense form is at its roofline and auto "
+                        "promotes the perm flag-stream kernel (decision "
+                        "journaled as a `backend` event); default None — "
+                        "auto stays on the committed dense path")
     p.add_argument("--overlap", default="off", choices=["off", "1step"],
                    help="software-pipelined gossip: '1step' issues each "
                         "step's exchange (begin_mix) and consumes it at the "
@@ -251,7 +264,9 @@ def parse_args(argv=None) -> TrainConfig:
         consensus_lr=args.consensus_lr,
         compress_warmup_epochs=args.compress_warmup_epochs,
         gossip_backend=args.backend, gossip_block_d=args.block_d,
-        gossip_w_window=args.w_window, overlap=args.overlap,
+        gossip_w_window=args.w_window,
+        gossip_measured_vs_ceiling=args.gossip_measured_vs_ceiling,
+        overlap=args.overlap,
         wire_dtype=args.wire_dtype, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         fault_plan=args.fault_plan, max_recoveries=args.max_recoveries,
