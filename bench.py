@@ -253,6 +253,91 @@ def overlap_wire_grid(sched, x, steps, n, dim, backend="dense", reps=2,
     return cells
 
 
+def staleness_grid(sched, x, steps, n, dim, backend="dense",
+                   ks=(1, 2, 4), local_steps=(1, 4), reps=2,
+                   time_left=None):
+    """The bounded-staleness grid (ISSUE 14): cells for staleness k ×
+    local_steps L, each carrying
+
+    * the *measured* k-deep pipelined gossip-chain rate
+      (``Communicator.run_pipelined`` over the L-thinned flag stream — the
+      exact ring arithmetic the async train loop runs; on a single chip
+      this validates mechanics and ring overhead, not a wall-clock win),
+    * the *modeled* fleet wall-clock under a planted period-4 straggler
+      (``plan.cost.straggler_step_times`` → ``simulate_fleet_wallclock``):
+      barrier-executor seconds vs bounded-staleness seconds, and the
+      straggler tax recovered, and
+    * the barrier tax priced through the attribution plane's own
+      ``critical_path_report`` (per-epoch gate/median/tax over synthetic
+      per-worker heartbeats) — the same pricing PR 11 applies to real
+      runs, so the recovered fraction is stated in its currency.
+
+    The k=1, L=1 cell IS the barrier model (one outstanding exchange =
+    wait on every peer's previous round), which anchors the comparison.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu.communicator import make_decen
+    from matcha_tpu.obs.attribution import critical_path_report
+    from matcha_tpu.plan import simulate_fleet_wallclock, \
+        straggler_step_times
+
+    steps = min(steps, len(sched.flags))
+    comm = make_decen(sched, backend=backend)
+    rounds = 64
+    # the straggler scenario and its critical-path pricing are grid-level
+    # facts (they do not depend on k or L): per-worker round times with
+    # the planted period-4 straggler, and the barrier tax in the
+    # attribution plane's own currency — critical_path_report over
+    # synthetic per-worker heartbeats (8 rounds per "epoch"), exactly the
+    # PR 11 pricing path
+    t_rounds = straggler_step_times(n, rounds, straggler=0, period=4,
+                                    slowdown=4.0, seed=1)
+    spe = 8
+    beats = {f"w{i}": [
+        {"epoch": e,
+         "comp_time": float(t_rounds[e * spe:(e + 1) * spe, i].sum()),
+         "comm_time": 0.0}
+        for e in range(rounds // spe)] for i in range(n)}
+    cp = critical_path_report((), heartbeats_by_host=beats)
+    cells = []
+    for k in ks:
+        for L in local_steps:
+            if time_left is not None and time_left() < 10.0:
+                # no silent caps: the emitted grid says what was dropped
+                print(f"# staleness grid truncated at "
+                      f"{len(cells)}/{len(ks) * len(local_steps)} cells: "
+                      f"{time_left():.0f}s left", file=sys.stderr)
+                return cells
+            flags = np.asarray(sched.flags, np.float32)[:steps].copy()
+            if L > 1:
+                flags[np.arange(steps) % L != 0] = 0.0
+            fj = jnp.asarray(flags)
+            run = jax.jit(lambda v, kk=k: jnp.sum(
+                comm.run_pipelined(v, fj, staleness=kk)[0][:, :8]
+                .astype(jnp.float32)))
+            float(run(x))  # compile + warmup (forced readback, see above)
+            rates = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(run(x))
+                rates.append(steps / (time.perf_counter() - t0))
+            # modeled fleet wall-clock of this cell's execution contract
+            model = simulate_fleet_wallclock(t_rounds, staleness=k,
+                                             local_steps=L)
+            cells.append({
+                "staleness": k, "local_steps": L,
+                "value": round(max(rates), 1),
+                "unit": "gossip_steps_per_sec",
+                "model": {kk: (round(v, 4) if isinstance(v, float) else v)
+                          for kk, v in model.items()},
+                "barrier_tax_priced_seconds":
+                    round(cp["total_tax_seconds"], 4),
+            })
+    return cells
+
+
 def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1, m=0):
     """Per-step FLOP and HBM-byte model for the Pallas/MXU backends,
     evaluated at the measured rate.  The fused kernel's traffic model is
@@ -384,6 +469,22 @@ def worker_main(args) -> int:
             except Exception as e:  # noqa: BLE001 — grid is a refinement
                 print(f"# overlap grid failed: {type(e).__name__}: "
                       f"{str(e)[:200]}", file=sys.stderr)
+        if (args.backend == "dense" and args.staleness_grid_steps
+                and time_left() > 30.0):
+            # same budget discipline as the overlap grid: 6 cells × (warmup
+            # + 2 reps) of a pipelined chain ~2-3× slower than the rate
+            # above — and the wall-clock model itself is host numpy, free
+            budget = min(60.0, max(time_left() - 30.0, 0.0))
+            gsteps = max(4, min(args.staleness_grid_steps, steps,
+                                int(value * budget / 54)))
+            try:
+                record["staleness_grid"] = staleness_grid(
+                    sched, x, gsteps, n, dim, time_left=time_left)
+                print(json.dumps(record))
+                sys.stdout.flush()
+            except Exception as e:  # noqa: BLE001 — grid is a refinement
+                print(f"# staleness grid failed: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
         return 0
 
     # --- primary: per-step (training-regime) fused kernel, chunk=1 ---------
@@ -503,6 +604,23 @@ def worker_main(args) -> int:
         print(f"# overlap grid skipped: {time_left():.0f}s left",
               file=sys.stderr)
 
+    # --- bounded-staleness grid (ISSUE 14): k × local_steps cells --------
+    # measured k-deep ring-chain rate + the modeled barrier-vs-bounded
+    # fleet wall-clock under a planted period-4 straggler
+    if args.staleness_grid_steps and time_left() > 45.0:
+        try:
+            record["staleness_grid"] = staleness_grid(
+                sched, x, args.staleness_grid_steps, n, dim,
+                time_left=time_left)
+            print(json.dumps(record))
+            sys.stdout.flush()
+        except Exception as e:  # noqa: BLE001 — grid is a refinement
+            print(f"# staleness grid failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+    elif args.staleness_grid_steps:
+        print(f"# staleness grid skipped: {time_left():.0f}s left",
+              file=sys.stderr)
+
     # --- secondary: chunked chain composition (consensus-only regime) ------
     if args.chunk > 1 and time_left() < 45.0:
         print(f"# chunked secondary skipped: {time_left():.0f}s left",
@@ -606,7 +724,8 @@ def orchestrate(args, passthrough) -> int:
                "--dtype", "f32", "--steps", str(args.cpu_steps),
                "--workers", str(args.workers),
                "--deadline", str(time.time() + args.provisional_timeout - 15.0),
-               "--overlap-grid-steps", str(args.overlap_grid_steps)]
+               "--overlap-grid-steps", str(args.overlap_grid_steps),
+               "--staleness-grid-steps", str(args.staleness_grid_steps)]
     if args.smoke:
         cpu_cmd.append("--smoke")
     rc, out, err, timed_out, secs = _run_bounded(
@@ -823,6 +942,14 @@ def main():
                         "(the pipelined/bf16-wire sweep; 0 disables). The "
                         "grid rides the dense per-step regime — the one the "
                         "overlapped training loop runs")
+    p.add_argument("--staleness-grid-steps", type=int, default=120,
+                   dest="staleness_grid_steps",
+                   help="chain length per bounded-staleness grid cell "
+                        "(k in {1,2,4} x local_steps in {1,4}; 0 disables): "
+                        "measured k-deep ring-chain rate + the modeled "
+                        "barrier-vs-bounded fleet wall-clock under a "
+                        "planted period-4 straggler, with the straggler "
+                        "tax priced through critical_path_report")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=240.0,
                    help="wall-clock bound per TPU measurement attempt (s)")
@@ -885,7 +1012,8 @@ def main():
                     "--chunk-block-d", str(args.chunk_block_d),
                     "--w-window", str(args.w_window),
                     "--w-sweep", args.w_sweep,
-                    "--overlap-grid-steps", str(args.overlap_grid_steps)]
+                    "--overlap-grid-steps", str(args.overlap_grid_steps),
+                    "--staleness-grid-steps", str(args.staleness_grid_steps)]
     if args.force_attempt_failure:  # test hook rides only the TPU attempts;
         passthrough.append("--force-attempt-failure")  # the provisional stays real
     return orchestrate(args, passthrough)

@@ -143,6 +143,27 @@ timeout -k 10 180 python obs_tpu.py timeline \
     || echo "timeline_r7: trace validation failed (see stderr)"
 rm -rf benchmarks/attrib_run_r7
 
+# 1.9 async_bench_r7 (ISSUE 14: bounded-staleness on real hardware).  The
+#     bench's staleness grid (k in {1,2,4} x local_steps in {1,4}) rides
+#     the driver artifact already; this step captures the *training-loop*
+#     async evidence: eager barrier vs --staleness 2 vs --staleness 2
+#     --local-steps 4 on whatever mesh the window exposes, per-epoch JSON
+#     lines persisted as the committable artifact (the same
+#     promissory-claim discipline as overlap_sweep).  On a single chip the
+#     k-ring cannot buy wall-clock (no straggler to decouple from) — the
+#     cells still pin ring overhead ~0 and the damped-alpha convergence;
+#     the modeled recovery claim stays with the staleness grid.
+rm -f benchmarks/async_bench_r7.json
+for st in "1 1" "2 1" "2 4"; do
+    set -- $st
+    echo "{\"sweep\": \"async r7\", \"staleness\": $1, \"local_steps\": $2}" \
+        >> benchmarks/async_bench_r7.json
+    timeout -k 30 420 python train_tpu.py --name "async-k$1-l$2" \
+        --model mlp --dataset synthetic --graphid 2 --numworkers 16 \
+        --epoch 3 --backend auto --overlap 1step --staleness "$1" \
+        --local-steps "$2" --no-comm-split >> benchmarks/async_bench_r7.json
+done
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist

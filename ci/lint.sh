@@ -44,6 +44,12 @@
 #                    series is all-zero — an unidentifiable run failing
 #                    loudly is the contract; exit 0 would mean noise was
 #                    laundered into measured fact)
+#  13. async lane + smoke  bounded-staleness gossip (k-deep pending ring,
+#                    staleness predictor + alpha damping, local steps,
+#                    fleet wall-clock model), as pytest (marker: async);
+#                    then a plan_tpu.py rho --staleness smoke — the
+#                    staleness-composed artifact must pass its own
+#                    planlint self-check and report the damped rho < 1
 #
 # Fast pre-commit variant: lint only what changed vs a ref —
 #
@@ -160,5 +166,27 @@ if JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python obs_tpu.py attribute \
     echo "attribute smoke: expected a non-zero exit on an unidentifiable run"
     rc=1
 fi
+
+echo "== async pytest lane (bounded-staleness gossip) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m async -p no:cacheprovider || rc=1
+
+echo "== async smoke (plan_tpu.py rho --staleness, planlint-self-checked) =="
+ASYNC_DIR="$(mktemp -d)"
+# --out arms the planlint self-check (exit 1 on a failing artifact); the
+# damped rho must come back < 1 — the k=2 pipeline the executor actually
+# runs is stable, and the artifact must say so
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python plan_tpu.py rho \
+    --graphid 5 --budget 0.5 --staleness 2 \
+    --out "$ASYNC_DIR/stale_plan.json" > "$ASYNC_DIR/rho.json" || rc=1
+python - "$ASYNC_DIR/rho.json" <<'PY' || rc=1
+import json, sys
+d = json.load(open(sys.argv[1]))
+stale = d["stale"]
+assert stale["staleness"] == 2, stale
+assert 0 < stale["stale_alpha_scale"] < 1, stale
+assert stale["rho_at_scaled_alpha"] < 1.0, stale
+PY
+rm -rf "$ASYNC_DIR"
 
 exit $rc

@@ -34,6 +34,12 @@ the delta has exactly zero column-mean — applying it a step late never
 moves the fleet average, only the per-worker spread (MATCHA's one-step
 staleness argument: the contraction factor is perturbed, not the
 convergence structure; see ``plan.spectral.stale_contraction_rho``).
+
+``run_pipelined`` generalizes the schedule to bounded staleness
+(consume-at-≤t+k, DESIGN.md §20): deltas age through a k-slot ring, the
+k=1 case is this contract bitwise, and the same zero-column-mean argument
+keeps the fleet average exact at any depth — only the contraction factor
+pays for the delay (the staleness-extended ``stale_contraction_rho``).
 """
 
 from __future__ import annotations
@@ -172,6 +178,82 @@ class Communicator:
         if drain:
             return self.apply_mix(x, pending), c
         return x, c, pending
+
+    def run_pipelined(self, flat: jax.Array, flags: jax.Array,
+                      carry: Any = None, alive: Any = None,
+                      staleness: int = 1, drain: bool = True):
+        """Scan the bounded-staleness pipeline: consume-at-≤t+k.
+
+        The k-slot generalization of :meth:`run_overlapped`: in-flight
+        deltas age through a static-shape ``[K, N, D]`` pending ring.  Step
+        *t* applies ring slot ``t mod K`` (the delta issued at *t−K* — a
+        zero during the first K warmup steps), then issues its own exchange
+        into the same slot.  ``staleness=1`` is bitwise the one-step
+        pipeline (the ring degenerates to the single pending buffer,
+        consumed and refilled in the identical order), pinned by
+        ``tests/test_staleness.py`` on every backend.  For K > 1 the
+        drained chain is *not* the eager W-chain — each delta is issued on
+        a state missing its K−1 in-flight predecessors; the perturbation
+        is the delayed-consensus recurrence ``plan.spectral.
+        stale_contraction_rho(staleness=K)`` bounds — but every delta
+        still has exactly zero column-mean, so the worker mean never
+        moves, drained or not.  When the flag stream fires at most once
+        every K steps (``local_steps ≥ K`` thinning), each delta is
+        consumed before the next is issued and the drained chain *does*
+        reproduce ``run`` exactly — the telescoping k=1 argument applies
+        event-by-event.
+
+        ``drain=True`` flushes the ring oldest-first so the result has
+        realized every issued exchange; ``drain=False`` returns
+        ``(visible_state, carry, ring)`` — what an epoch boundary of the
+        k-deep train loop holds.  ``alive`` as in :meth:`run_overlapped`.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        k = int(staleness)
+        if k < 1:
+            raise ValueError(f"staleness must be >= 1, got {staleness}")
+        if carry is None:
+            carry = self.init(flat)
+        flags = jnp.asarray(flags, jnp.float32)
+        ring = jnp.zeros((k,) + flat.shape, flat.dtype)
+        if flags.shape[0] == 0:
+            return (flat, carry) if drain else (flat, carry, ring)
+        if alive is not None:
+            alive = jnp.asarray(alive, jnp.float32)
+
+        def body(state, xs):
+            x, c, pend, t = state
+            flags_t, alive_t = xs
+            slot = lax.rem(t, k)
+            x = self.apply_mix(
+                x, lax.dynamic_index_in_dim(pend, slot, 0, keepdims=False))
+            d, c = self.begin_mix(x, c, flags_t, alive_t)
+            pend = lax.dynamic_update_index_in_dim(pend, d, slot, 0)
+            return (x, c, pend, t + 1), None
+
+        t0 = jnp.zeros((), jnp.int32)
+        if alive is None or alive.ndim == 1:
+            a = alive  # None or constant row: closed over, not scanned
+
+            def body_const(state, flags_t):
+                return body(state, (flags_t, a))
+
+            (x, c, ring, t), _ = lax.scan(
+                body_const, (flat, carry, ring, t0), flags)
+        else:
+            (x, c, ring, t), _ = lax.scan(
+                body, (flat, carry, ring, t0), (flags, alive))
+        if not drain:
+            return x, c, ring
+        # flush oldest-first: after T steps slot (T+i) mod K holds the
+        # delta issued at step T−K+i — issue order is the apply order
+        for i in range(k):
+            slot = lax.rem(t + i, k)
+            x = self.apply_mix(
+                x, lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False))
+        return x, c
 
     def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None,
             alive: Any = None):

@@ -52,20 +52,28 @@ def compose_predicted_rho(
     wire_dtype=None,
     worker_alive: Optional[np.ndarray] = None,
     link_up: Optional[np.ndarray] = None,
+    staleness=1,
+    local_steps: int = 1,
 ) -> Dict[str, float]:
     """The plan's full ρ composition for a running config, with provenance.
 
     Exactly the stack ``plan_tpu.py rho`` reports: the degraded solver
     inputs (fault plan expectations) feed the staleness/wire-adjusted
     bound, so one number accounts for everything the executor is known to
-    do to the schedule.  Returns ``{"rho", "rho_base", "wire_eps",
-    "floor_rel"}`` — ``rho`` is the composed bound the drift monitor
-    compares against, ``rho_base`` the fault-free eager f32 bound,
-    ``floor_rel`` the bf16 consensus floor relative to parameter RMS
-    (0 for f32 wire).
+    do to the schedule.  ``staleness`` (an int or ``{delay: prob}``
+    distribution) and ``local_steps`` compose the bounded-staleness
+    pipeline's delayed-recurrence inflation and the local-step exponent
+    into the same number (``plan.spectral.stale_contraction_rho``) — the
+    drift monitor then falsifies the *async* contract live, exactly as it
+    does the eager one.  Returns ``{"rho", "rho_base", "wire_eps",
+    "floor_rel", "staleness", "local_steps"}`` — ``rho`` is the composed
+    bound the drift monitor compares against, ``rho_base`` the fault-free
+    eager f32 bound, ``floor_rel`` the bf16 consensus floor relative to
+    parameter RMS (0 for f32 wire).
     """
     from ..plan.spectral import (
         degraded_solver_inputs,
+        normalize_staleness,
         stale_contraction_rho,
         wire_disagreement_floor,
         wire_quantization_eps,
@@ -79,12 +87,20 @@ def compose_predicted_rho(
     dLs, dp = degraded_solver_inputs(Ls, p, worker_alive, link_up)
     composed = float(stale_contraction_rho(dLs, dp, float(alpha),
                                            overlap=overlap,
-                                           wire_dtype=wire_dtype))
+                                           wire_dtype=wire_dtype,
+                                           staleness=staleness,
+                                           local_steps=local_steps))
+    delays = normalize_staleness(staleness)
     return {
         "rho": composed,
         "rho_base": base,
         "wire_eps": float(wire_quantization_eps(wire_dtype)),
         "floor_rel": float(wire_disagreement_floor(wire_dtype)),
+        # JSON-safe staleness record: the point-mass int, or the
+        # distribution with stringified delay keys
+        "staleness": (max(delays) if len(delays) == 1
+                      else {str(d): pr for d, pr in delays.items()}),
+        "local_steps": int(local_steps),
     }
 
 

@@ -66,9 +66,18 @@ class Telemetry(struct.PyTreeNode):
     healed: jax.Array             # rows healed from the survivor mean
     worker_alive_sum: jax.Array   # f32[N] Σ per-worker participation
     worker_disagreement_sum: jax.Array  # f32[N] Σ per-worker deviation
+    # f32[N, K+1] per-worker consumed-age histogram of the bounded-
+    # staleness ring (DESIGN.md §20): bin a counts worker i's consumes of
+    # an age-a delta; bin 0 is the empty-slot consume (warmup, post-heal,
+    # vacant slot).  Worker-major like every per-worker leaf — that is
+    # what lets shard_workers fold it onto a mesh; the flush reports the
+    # fleet sum.  [N, 2] (a vestigial bin) when staleness is 1 — the
+    # accumulator's pytree depends only on the run's static contract,
+    # never on runtime values.
+    stale_age_hist: jax.Array
 
     @classmethod
-    def zeros(cls, num_workers: int) -> "Telemetry":
+    def zeros(cls, num_workers: int, staleness: int = 1) -> "Telemetry":
         # one fresh buffer per field: the scanned epoch *donates* the
         # state, and donation rejects the same buffer appearing twice —
         # a single shared zeros() would alias every leaf
@@ -83,7 +92,9 @@ class Telemetry(struct.PyTreeNode):
                    alive_min=jnp.asarray(jnp.inf, jnp.float32),
                    stale_steps=z(), stale_dropped=z(), quantized_values=z(),
                    healed=z(), worker_alive_sum=zn(),
-                   worker_disagreement_sum=zn())
+                   worker_disagreement_sum=zn(),
+                   stale_age_hist=jnp.zeros(
+                       (int(num_workers), int(staleness) + 1), jnp.float32))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,21 +106,25 @@ class TelemetrySpec:
     how many values it rounds (0-cost to carry both; the quantize counter
     needs values, the byte counter needs bytes).  ``quantizing`` is True
     when the wire dtype is narrower than f32; ``overlap`` when the
-    pipelined (one-step-stale) schedule runs.
+    pipelined (one-step-stale) schedule runs; ``staleness`` is the
+    pipeline depth K (sizes the consumed-age histogram — ages clip to K).
     """
 
     wire_bytes_per_matching: np.ndarray
     wire_values_per_matching: np.ndarray
     quantizing: bool
     overlap: bool
+    staleness: int = 1
 
 
 def make_telemetry_spec(decomposed: Sequence[Sequence[tuple]], dim: int,
-                        wire_dtype=None, overlap: str = "off") -> TelemetrySpec:
+                        wire_dtype=None, overlap: str = "off",
+                        staleness: int = 1) -> TelemetrySpec:
     """Bake a schedule's static exchange accounting into a spec.
 
     ``decomposed``: the schedule's matchings (edge lists); ``dim`` the flat
-    parameter dimension; ``wire_dtype``/``overlap`` the run's knobs.
+    parameter dimension; ``wire_dtype``/``overlap``/``staleness`` the
+    run's knobs.
     """
     from ..parallel.gossip import matching_wire_bytes, resolve_wire_dtype
 
@@ -124,6 +139,7 @@ def make_telemetry_spec(decomposed: Sequence[Sequence[tuple]], dim: int,
         wire_values_per_matching=bytes_vec / np.float32(bytes_el),
         quantizing=bytes_el < 4,
         overlap=overlap == "1step",
+        staleness=int(staleness),
     )
 
 
@@ -136,6 +152,7 @@ def telemetry_step(
     alive_count: jax.Array,
     healed: Optional[jax.Array] = None,
     stale_dropped: Optional[jax.Array] = None,
+    consumed_age: Optional[jax.Array] = None,
     worker_alive: Optional[jax.Array] = None,
     worker_disagreement: Optional[jax.Array] = None,
 ) -> Telemetry:
@@ -144,15 +161,24 @@ def telemetry_step(
     ``flags_t: f32[M]`` is this step's activation row; the wire accounting
     is a dot with the spec's static per-matching vectors.  ``healed`` /
     ``stale_dropped`` are this step's heal counts (None when the fault
-    machinery is off — compiles the zero-cost path).  ``worker_alive`` /
-    ``worker_disagreement`` are this step's f32[N] participation mask and
-    per-row consensus deviation (None compiles the all-participating /
-    zero-deviation accumulation — the pre-health program's cost).
+    machinery is off — compiles the zero-cost path).  ``consumed_age``:
+    i32[N] — the age of the delta each worker consumed this step from the
+    bounded-staleness ring (−1 = empty slot; ages land in histogram bin
+    ``clip(age, 0, K)``).  None (the non-ring paths) leaves the histogram
+    untouched.  ``worker_alive`` / ``worker_disagreement`` are this step's
+    f32[N] participation mask and per-row consensus deviation (None
+    compiles the all-participating / zero-deviation accumulation — the
+    pre-health program's cost).
     """
     one = jnp.ones((), jnp.float32)
     zero = jnp.zeros((), jnp.float32)
     wire_bytes = jnp.dot(flags_t, jnp.asarray(spec.wire_bytes_per_matching))
     wire_values = jnp.dot(flags_t, jnp.asarray(spec.wire_values_per_matching))
+    hist = tel.stale_age_hist
+    if consumed_age is not None:
+        bins = jnp.clip(consumed_age, 0, spec.staleness)
+        hist = hist + jax.nn.one_hot(bins, spec.staleness + 1,
+                                     dtype=jnp.float32)
     return tel.replace(
         steps=tel.steps + one,
         disagreement_sum=tel.disagreement_sum + disagreement,
@@ -167,6 +193,7 @@ def telemetry_step(
         quantized_values=tel.quantized_values
         + (wire_values if spec.quantizing else zero),
         healed=tel.healed + (healed if healed is not None else zero),
+        stale_age_hist=hist,
         worker_alive_sum=tel.worker_alive_sum
         + (worker_alive if worker_alive is not None
            else jnp.ones_like(tel.worker_alive_sum)),
@@ -203,6 +230,12 @@ def telemetry_flush(tel: Any) -> Dict[str, float]:
         "alive_min": alive_min if np.isfinite(alive_min) else float("nan"),
         "stale_steps": float(np.asarray(tel.stale_steps)),
         "stale_dropped": float(np.asarray(tel.stale_dropped)),
+        # consumed-age histogram of the staleness ring, summed over the
+        # fleet (bin 0 = empty-slot consumes; bin a = age-a deltas) —
+        # [0, 0] outside ring runs
+        "stale_age_hist": [float(v) for v in
+                           np.asarray(tel.stale_age_hist, np.float64)
+                           .sum(axis=0)],
         "quantized_values": float(np.asarray(tel.quantized_values)),
         "healed": float(np.asarray(tel.healed)),
         "worker_participation": [float(v) for v in w_alive / denom],

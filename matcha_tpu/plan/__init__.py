@@ -41,7 +41,10 @@ from .cost import (
     expected_comm_units,
     load_measured_comm_times,
     load_measured_link_costs,
+    load_measured_vs_ceiling,
     matching_comm_units,
+    simulate_fleet_wallclock,
+    straggler_step_times,
 )
 from .spectral import (
     ConsensusSim,
@@ -49,8 +52,12 @@ from .spectral import (
     degraded_solver_inputs,
     empirical_contraction_rate,
     masked_laplacian_expectation,
+    normalize_staleness,
+    parse_staleness_spec,
     simulate_consensus,
+    stale_alpha_rescale,
     stale_contraction_rho,
+    staleness_delay_inflation,
     steps_to_consensus,
     wire_disagreement_floor,
     wire_quantization_eps,
@@ -76,15 +83,22 @@ __all__ = [
     "load_fault_ledger",
     "load_measured_comm_times",
     "load_measured_link_costs",
+    "load_measured_vs_ceiling",
     "load_plan",
     "load_recorder_disagreement",
     "matching_comm_units",
+    "normalize_staleness",
+    "parse_staleness_spec",
     "plan_candidate",
     "resolve_topology",
     "save_plan",
     "simulate_consensus",
+    "simulate_fleet_wallclock",
+    "stale_alpha_rescale",
     "stale_contraction_rho",
+    "staleness_delay_inflation",
     "steps_to_consensus",
+    "straggler_step_times",
     "sweep",
     "verify_against_recorder",
     "verify_plan_run",

@@ -46,6 +46,9 @@ __all__ = [
     "gossip_backend_entries",
     "load_measured_comm_times",
     "load_measured_link_costs",
+    "load_measured_vs_ceiling",
+    "simulate_fleet_wallclock",
+    "straggler_step_times",
 ]
 
 
@@ -338,6 +341,165 @@ def load_measured_link_costs(data) -> Tuple[dict, str]:
         raise ValueError(f"{label}: format {fmt!r} is not a "
                          f"matcha_tpu.link_costs artifact")
     return data, label
+
+
+def load_measured_vs_ceiling(source: str) -> Tuple[float, dict]:
+    """Extract the dense/fused formulation's measured-vs-ceiling ratio from
+    a committed artifact — the :func:`choose_gossip_backend` gate input,
+    without an operator transcribing numbers (the ISSUE 13 follow-on).
+
+    Three source shapes resolve, newest record winning:
+
+    * a run-journal JSONL whose ``bench`` events carry a roofline report
+      (``obs_tpu.py roofline --journal``): the report's
+      ``measured_vs_ceiling`` + ``measured_vs_ceiling_backend``;
+    * a ``bench_live_r*.json`` capture (``{"record": {...}}``) or raw
+      bench record: the fused/dense kernel's ``mfu`` — the fused chain is
+      MXU-bound, so its compute-bound MFU *is* the measured/ceiling ratio;
+    * a raw roofline-report JSON (the ``roofline_report`` dict).
+
+    Only dense/fused-backend ratios qualify (a perm rate against the perm
+    ceiling says nothing about the dense form's headroom — the denominator
+    mis-citation ``measured_vs_ceiling_backend`` exists to prevent).
+    Returns ``(ratio, provenance)``; raises ``ValueError`` when the source
+    has no usable ratio — ``auto`` must never promote on a measurement
+    that silently failed to load.
+    """
+    def _from_report(rep: dict, where: str):
+        if not isinstance(rep, dict):
+            return None
+        ratio = rep.get("measured_vs_ceiling")
+        backend = rep.get("measured_vs_ceiling_backend",
+                          rep.get("backend"))
+        if ratio is None:
+            ratio = rep.get("mfu")  # bench records: compute-bound MFU
+        if ratio is None or backend not in ("dense", "fused"):
+            return None
+        return float(ratio), {"path": source, "record": where,
+                              "backend": str(backend),
+                              "measured_vs_ceiling": float(ratio)}
+
+    with open(source) as f:
+        text = f.read()
+    candidates = []
+    try:
+        data = json.loads(text)
+        if isinstance(data, dict):
+            candidates = [data.get("record", data), data,
+                          data.get("roofline", {})]
+    except json.JSONDecodeError:
+        # JSONL journal: scan every event, newest last
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rec = e.get("record", e) if isinstance(e, dict) else {}
+            if isinstance(rec, dict):
+                candidates.append(rec.get("roofline", rec))
+    hit = None
+    for i, cand in enumerate(candidates):
+        got = _from_report(cand, f"entry {i}")
+        if got is not None:
+            hit = got  # keep scanning: the newest usable record wins
+    if hit is None:
+        raise ValueError(
+            f"{source}: no dense/fused measured-vs-ceiling ratio found "
+            f"(want a roofline report's measured_vs_ceiling or a bench "
+            f"record's mfu with backend dense|fused) — refusing to gate "
+            f"the backend choice on a missing measurement")
+    return hit
+
+
+# ---------------------------------------------------------------------------
+# Bounded-staleness fleet wall-clock model (the straggler-tax pricing)
+# ---------------------------------------------------------------------------
+
+def straggler_step_times(
+    num_workers: int,
+    rounds: int,
+    base_s: float = 1.0,
+    straggler: int = 0,
+    period: int = 4,
+    slowdown: float = 4.0,
+    jitter: float = 0.05,
+    seed: int = 0,
+) -> np.ndarray:
+    """f64[rounds, N] per-worker gossip-round durations with one planted
+    periodic straggler: worker ``straggler`` takes ``slowdown×`` base every
+    ``period``-th round (a GC pause / preemption / slow shard — the
+    classic period-4 straggler the bench grid plants), everyone carries
+    i.i.d. lognormal-ish jitter.  Host-side numpy; the input of
+    :func:`simulate_fleet_wallclock`."""
+    rng = np.random.default_rng(seed)
+    t = base_s * (1.0 + jitter * np.abs(rng.standard_normal(
+        (int(rounds), int(num_workers)))))
+    t[np.arange(int(rounds)) % int(period) == 0, int(straggler)] *= \
+        float(slowdown)
+    return t
+
+
+def simulate_fleet_wallclock(
+    step_times: np.ndarray, staleness: int = 1, local_steps: int = 1
+) -> dict:
+    """Fleet wall-clock of a gossip-round schedule under three execution
+    models, from per-worker round durations ``f64[rounds, N]``.
+
+    * **barrier** — every round is a fleet-wide barrier (the committed
+      synchronous executor): total = Σ_r max_i t[r, i].  This is exactly
+      what ``obs.attribution.critical_path_report`` prices from heartbeats
+      — the straggler tax is the gate-minus-median sum.
+    * **bounded staleness** — worker i may start round r once it finished
+      r−1 *and* every peer has finished round r−k_ev (its delta from that
+      round is the oldest thing i is allowed to still be missing):
+      ``T_i(r) = max(T_i(r−1), max_j T_j(r−k_ev)) + t[r, i]`` with
+      ``k_ev = ceil(staleness / local_steps)`` outstanding exchanges.
+      Conservative: the dependency is fleet-wide, not per-matching — real
+      topology-aware slack is larger, so the recovered tax reported here
+      is a floor.
+    * **ideal** — no coupling at all (the unreachable bound):
+      max_i Σ_r t[r, i].
+
+    Returns the three totals plus ``tax_seconds`` (barrier − ideal: the
+    full straggler tax the barrier pays), ``recovered_seconds`` (barrier −
+    bounded: what the k-deep pipeline buys back), and
+    ``recovered_fraction`` (recovered / tax, 0 when the tax is 0).
+    Consistency: ``staleness=1, local_steps=1`` IS the barrier model (one
+    outstanding exchange means waiting on every peer's previous round) —
+    pinned by test.
+    """
+    t = np.asarray(step_times, np.float64)
+    if t.ndim != 2:
+        raise ValueError(f"step_times must be [rounds, N], got {t.shape}")
+    k_ev = max(-(-int(staleness) // max(int(local_steps), 1)), 1)
+    rounds, n = t.shape
+    barrier = float(np.sum(t.max(axis=1)))
+    ideal = float(np.max(t.sum(axis=0)))
+    finish = np.zeros((rounds, n))
+    for r in range(rounds):
+        start = finish[r - 1] if r >= 1 else np.zeros(n)
+        if r - k_ev >= 0:
+            start = np.maximum(start, float(finish[r - k_ev].max()))
+        finish[r] = start + t[r]
+    bounded = float(finish[-1].max())
+    tax = max(barrier - ideal, 0.0)
+    recovered = max(barrier - bounded, 0.0)
+    return {
+        "rounds": int(rounds),
+        "workers": int(n),
+        "staleness": int(staleness),
+        "local_steps": int(local_steps),
+        "event_depth": int(k_ev),
+        "barrier_seconds": barrier,
+        "bounded_seconds": bounded,
+        "ideal_seconds": ideal,
+        "tax_seconds": tax,
+        "recovered_seconds": recovered,
+        "recovered_fraction": (recovered / tax) if tax > 0 else 0.0,
+    }
 
 
 def load_measured_comm_times(path: str) -> list:

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,7 +46,11 @@ __all__ = [
     "masked_laplacian_expectation",
     "degraded_contraction_rho",
     "degraded_solver_inputs",
+    "normalize_staleness",
+    "parse_staleness_spec",
+    "stale_alpha_rescale",
     "stale_contraction_rho",
+    "staleness_delay_inflation",
     "wire_disagreement_floor",
     "wire_quantization_eps",
 ]
@@ -152,6 +156,8 @@ def simulate_consensus(
     laplacians: Optional[np.ndarray] = None,
     overlap: str = "off",
     wire_dtype=None,
+    staleness: int = 1,
+    local_steps: int = 1,
 ) -> ConsensusSim:
     """Simulate ``x ← W_t x`` under sampled Bernoulli activation flags.
 
@@ -163,20 +169,34 @@ def simulate_consensus(
     ``dim`` random directions.
 
     ``overlap="1step"`` simulates the *pipelined* recurrence the overlapped
-    train loop runs (``Communicator.run_overlapped``): step *t* applies the
-    delta issued at *t−1*, then issues its own — the measured trajectory is
-    the visible (one-mix-behind) state.  The pending delta is renormalized
-    alongside ``x`` (the recurrence is linear, so the joint rescaling is
-    exact) and ``rho_bound`` comes from :func:`stale_contraction_rho`, which
-    must bound the empirical rate exactly as the eager bound does.
+    train loop runs (``Communicator.run_pipelined``): step *t* applies the
+    delta sitting in pending-ring slot ``t mod k`` (issued at step *t−k*),
+    then issues its own into the same slot — the measured trajectory is the
+    visible (k-mixes-behind) state.  ``staleness=1`` is the committed
+    one-step pipeline; ``staleness=k`` ages deltas through a k-slot ring,
+    the exact arithmetic of ``TrainState.mix_pending`` at ``--staleness k``.
+    ``local_steps=L`` statically thins the flag stream to every L-th row
+    (the skipped steps mix by I and issue zero deltas), mirroring the train
+    loop's thinning.  Pending deltas are renormalized alongside ``x`` (the
+    recurrence is linear, so the joint rescaling is exact) and
+    ``rho_bound`` comes from :func:`stale_contraction_rho`, which must
+    bound the empirical rate exactly as the eager bound does.
     ``wire_dtype="bf16"`` rounds the exchanged state through the wire dtype
     before each ``W`` application, mirroring the executor's boundary cast.
     """
     if overlap not in ("off", "1step"):
         raise ValueError(f"overlap must be 'off' or '1step', got {overlap!r}")
-    # validates wire_dtype up front: a bad spelling must fail here, not
-    # after the trials×steps MC loop has already been paid for
+    # validates wire_dtype / staleness / local_steps up front: a bad spec
+    # must fail here, not after the trials×steps MC loop has been paid for
     quantizing = wire_quantization_eps(wire_dtype) > 0.0
+    k = int(staleness)
+    L_steps = int(local_steps)
+    if k < 1:
+        raise ValueError(f"staleness must be >= 1, got {staleness}")
+    if L_steps < 1:
+        raise ValueError(f"local_steps must be >= 1, got {local_steps}")
+    if k > 1 and overlap != "1step":
+        raise ValueError("staleness > 1 needs overlap='1step'")
     if laplacians is None:
         laplacians = matching_laplacians(decomposed, size)
     Ls = np.asarray(laplacians, dtype=np.float64)
@@ -188,19 +208,22 @@ def simulate_consensus(
     for trial in range(trials):
         rng = np.random.default_rng(seed * 7919 + trial)
         flags = sample_flags(p, steps, seed=seed * 7919 + trial)
+        if L_steps > 1:  # periodic thinning: gossip only every L-th step
+            flags = flags * (np.arange(steps)[:, None] % L_steps == 0)
         x = _consensus_component(rng.standard_normal((size, dim)))
         norm = math.sqrt(float(np.sum(x * x)))
         x /= max(norm, 1e-300)
-        pending = np.zeros_like(x)
+        ring = np.zeros((k,) + x.shape)
         log_e = 0.0
         for t in range(steps):
             W = eye - alpha * np.tensordot(
                 flags[t].astype(np.float64), Ls, axes=1
             )
             if pipelined:
-                x = x + pending  # consume the exchange issued at t−1
+                slot = t % k
+                x = x + ring[slot]  # consume the exchange issued at t−k
                 xw = _wire_quantize(x, wire_dtype) if quantizing else x
-                pending = W @ xw - xw  # issue this step's exchange
+                ring[slot] = W @ xw - xw  # issue this step's exchange
                 x = _consensus_component(x)
             elif not quantizing:
                 x = _consensus_component(W @ x)  # re-project: guards fp drift
@@ -215,10 +238,11 @@ def simulate_consensus(
             scale = max(math.sqrt(e), 1e-300)
             x /= scale  # renormalize: no underflow ever
             if pipelined:
-                pending /= scale  # joint rescale: the recurrence is linear
-    rho = stale_contraction_rho(Ls, p, float(alpha), overlap="1step",
-                                wire_dtype=wire_dtype) \
-        if (pipelined or quantizing) \
+                ring /= scale  # joint rescale: the recurrence is linear
+    rho = stale_contraction_rho(Ls, p, float(alpha), overlap=overlap,
+                                wire_dtype=wire_dtype, staleness=k,
+                                local_steps=L_steps) \
+        if (pipelined or quantizing or L_steps > 1) \
         else contraction_rho(Ls, p, float(alpha))
     return ConsensusSim(log_errors=log_errors, rho_bound=float(rho),
                         alpha=float(alpha))
@@ -329,29 +353,174 @@ def degraded_contraction_rho(
     return float(contraction_rho(Ls, p, float(alpha)))
 
 
+def normalize_staleness(staleness) -> dict:
+    """Normalize a staleness spec to ``{delay_steps: probability}``.
+
+    Accepts an int ``k ≥ 1`` (point mass — the executor's contract: a delta
+    issued at step t is consumed at step t+k), or a mapping/sequence of
+    ``(delay, weight)`` pairs (a *distribution* over consume ages — the
+    planner's what-if knob for straggler scenarios, e.g. ``{1: 0.75, 4:
+    0.25}`` for a period-4 straggler whose deltas arrive three rounds
+    late).  Weights must be positive and are normalized to sum to 1; delays
+    must be integers ≥ 1.  Raises ``ValueError`` on anything else — a bad
+    spec must fail before the eigensolve, not produce a silent k=1 bound.
+    """
+    if isinstance(staleness, (int, np.integer)):
+        if staleness < 1:
+            raise ValueError(f"staleness must be >= 1, got {staleness}")
+        return {int(staleness): 1.0}
+    if isinstance(staleness, dict):
+        items = list(staleness.items())
+    else:
+        try:
+            items = [(d, p) for d, p in staleness]
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"staleness must be an int >= 1 or a {{delay: prob}} "
+                f"distribution, got {staleness!r}")
+    if not items:
+        raise ValueError("staleness distribution is empty")
+    out: dict = {}
+    for d, p in items:
+        di, pf = int(d), float(p)
+        if di < 1 or di != float(d):
+            raise ValueError(f"staleness delays must be integers >= 1, "
+                             f"got {d!r}")
+        if not pf > 0:
+            raise ValueError(f"staleness weights must be > 0, got {p!r} "
+                             f"for delay {di}")
+        out[di] = out.get(di, 0.0) + pf
+    total = sum(out.values())
+    return {d: p / total for d, p in sorted(out.items())}
+
+
+def parse_staleness_spec(text: str) -> dict:
+    """Parse the CLI spelling ``"1:0.75,4:0.25"`` (or a bare int ``"2"``)
+    into the :func:`normalize_staleness` dict — the ``plan_tpu.py
+    --staleness-dist`` format."""
+    text = str(text).strip()
+    if ":" not in text:
+        return normalize_staleness(int(text))
+    pairs = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            d, p = part.split(":")
+            pairs.append((int(d), float(p)))
+        except ValueError:
+            raise ValueError(f"bad staleness-dist entry {part!r} "
+                             f"(want delay:prob, e.g. 1:0.75,4:0.25)")
+    return normalize_staleness(pairs)
+
+
+def _max_delay_root(gain: float, delays: dict) -> float:
+    """Max-modulus root of the delayed-consensus characteristic polynomial.
+
+    One eigenmode of the expected mixing with Laplacian gain ``a = α·μ``
+    evolves, under the k-deep pipeline (consume-at-t+k), as the delayed
+    recurrence ``x_t = x_{t−1} − a·Σ_d π(d)·x_{t−d}`` — the mean-field form
+    of the executor's pending-ring arithmetic (``TrainState.mix_pending``:
+    each step applies the delta issued ``d`` steps ago).  Its modes are the
+    roots of ``z^D − z^{D−1} + a·Σ_d π(d)·z^{D−d}`` with ``D = max d``;
+    the slowest root's modulus is the per-step contraction of that mode.
+    Point delay 1 recovers the eager root ``1 − a`` exactly — the
+    constructive k=1 telescoping argument in closed form.  Large gains
+    under deep delay can push the modulus past 1: delayed overcompensation
+    oscillates — that is a real instability, reported honestly as ρ ≥ 1.
+    """
+    D = max(delays)
+    if D == 1:
+        return abs(1.0 - gain)
+    coeffs = np.zeros(D + 1, dtype=np.float64)
+    coeffs[0] = 1.0
+    coeffs[1] = -1.0
+    for d, p in delays.items():
+        coeffs[d] += gain * p
+    return float(np.max(np.abs(np.roots(coeffs))))
+
+
+def staleness_delay_inflation(
+    laplacians: np.ndarray, probs: np.ndarray, alpha: float, delays: dict
+) -> float:
+    """Multiplicative ρ inflation of the k-deep pipeline over the eager
+    schedule: ``(max-mode delayed root / max-mode eager root)²``.
+
+    Mode gains are ``α·μ_i`` over the consensus eigenvalues of the expected
+    Laplacian ``E[L] = Σ p_j L_j`` (the zero mode — the worker mean the
+    pipeline provably never moves — is excluded).  The delayed root is
+    maximized over modes *independently* of the eager maximizer: delay can
+    inflate a mode the eager bound did not rank worst.  Returns 1.0 exactly
+    for point delay 1 (every root is the eager root), ≥ 1 otherwise.
+    """
+    Ls = np.asarray(laplacians, np.float64)
+    mean_L = np.tensordot(np.asarray(probs, np.float64), Ls, axes=1)
+    mu = np.linalg.eigvalsh(mean_L)[1:]  # drop the consensus zero mode
+    if mu.size == 0:
+        return 1.0
+    gains = float(alpha) * mu
+    eager = float(np.max(np.abs(1.0 - gains)))
+    delayed = float(max(_max_delay_root(float(a), delays) for a in gains))
+    if eager <= 0.0:
+        # one-shot-exact expected mixing (complete-graph degenerate case):
+        # the delayed modulus IS the whole story
+        return math.inf if delayed > 0 else 1.0
+    return max((delayed / eager) ** 2, 1.0)
+
+
 def stale_contraction_rho(
     laplacians: np.ndarray,
     probs: np.ndarray,
     alpha: float,
     overlap: str = "1step",
     wire_dtype=None,
+    staleness=1,
+    local_steps: int = 1,
 ) -> float:
-    """Contraction bound for the *pipelined* (one-step-stale) schedule with
-    an optionally narrowed wire.
+    """Contraction bound for the *pipelined* (bounded-staleness) schedule
+    with an optionally narrowed wire and optional local SGD steps.
 
-    Two effects, treated separately because they are separate:
+    Effects, treated separately because they are separate:
 
-    * **Staleness** (``overlap="1step"``): the pipelined step issues the
-      exchange on the post-apply state ``x_t`` and applies it to
-      ``x_t + u_{t+1}`` — so on the *consensus component* the realized
-      product is exactly the eager W-chain, shifted by one step (proved
-      constructively by ``Communicator.run_overlapped``'s drain
-      equivalence).  The homogeneous contraction factor is therefore
+    * **One-step staleness** (``overlap="1step"``, ``staleness=1``): the
+      pipelined step issues the exchange on the post-apply state ``x_t``
+      and applies it to ``x_t + u_{t+1}`` — so on the *consensus component*
+      the realized product is exactly the eager W-chain, shifted by one
+      step (proved constructively by ``Communicator.run_overlapped``'s
+      drain equivalence).  The homogeneous contraction factor is therefore
       **unchanged**; what staleness costs is one extra round on the
       gradient-injection term (each update joins consensus one W late) —
       a constant-offset delay of the decay curve, not a rate change.  This
       is MATCHA's own staleness argument (arXiv:1905.09435): delayed mixing
       perturbs the constants, not the convergence structure.
+
+    * **Bounded staleness k > 1** (``staleness=k`` or a ``{delay: prob}``
+      distribution): with k deltas in flight the telescoping argument
+      breaks — each delta is issued on a state missing its k−1 in-flight
+      predecessors, and the consensus component follows a genuinely
+      *delayed* linear recurrence.  Per eigenmode of the expected mixing
+      the rate is the max-modulus root of the delay polynomial
+      ``z^D − z^{D−1} + αμ·Σ_d π(d)z^{D−d}``
+      (:func:`staleness_delay_inflation`); the bound scales the eager ρ —
+      which carries the Bernoulli variance correction — by the worst-mode
+      ``(delayed root / eager root)²``.  Consistency: point delay 1 is a
+      no-op (ratio exactly 1); deeper delay only inflates, and a gain
+      large enough to oscillate under delay honestly reports ρ ≥ 1
+      (delayed overcompensation is a real divergence, not a modeling
+      artifact).  The MC simulator runs the exact ring recurrence and the
+      predictor ≥ MC zoo invariant extends to it
+      (``tests/test_staleness.py``).
+
+    * **Local steps** (``local_steps=L``): gossip fires only every L-th
+      step (the train loop statically thins the flag stream; the skipped
+      steps mix by exactly I).  Per L-step block the contraction is one
+      gossip event's ρ, so the per-step rate is ``ρ_event^(1/L)`` —
+      *exact* for periodic thinning, no Bernoulli approximation.  Delays
+      convert to gossip-event units as ``ceil(d/L)``: a delta consumed
+      before the next exchange is issued (d ≤ L) telescopes exactly like
+      k=1, which is why ``staleness=k, local_steps≥k`` returns the eager
+      bound — the drain-equivalence tests pin this constructively.
 
     * **Wire quantization** (``wire_dtype="bf16"``): the exchanged values
       are rounded, so the realized delta is ``(1+η)·Δ`` with
@@ -387,16 +556,85 @@ def stale_contraction_rho(
     """
     if overlap not in ("off", "1step"):
         raise ValueError(f"overlap must be 'off' or '1step', got {overlap!r}")
+    delays = normalize_staleness(staleness)
+    L_steps = int(local_steps)
+    if L_steps < 1:
+        raise ValueError(f"local_steps must be >= 1, got {local_steps}")
+    if overlap != "1step" and max(delays) > 1:
+        raise ValueError(
+            "staleness > 1 needs the pipelined schedule (overlap='1step'): "
+            "the eager path has no pending ring to age deltas through")
     Ls = np.asarray(laplacians, np.float64)
     if Ls.shape[-1] < 2:
         return 1.0  # zero/one survivor (fully-degraded input): no process
-    rho = float(contraction_rho(Ls, np.asarray(probs, np.float64),
-                                float(alpha)))
+    p = np.asarray(probs, np.float64)
+    rho = float(contraction_rho(Ls, p, float(alpha)))
+    if overlap == "1step":
+        # delays in gossip-event units: a delta consumed before the next
+        # exchange is issued telescopes exactly (ceil(d/L) = 1 ⇒ no-op)
+        event_delays: dict = {}
+        for d, pr in delays.items():
+            ev = -(-d // L_steps)
+            event_delays[ev] = event_delays.get(ev, 0.0) + pr
+        if max(event_delays) > 1:
+            rho = rho * staleness_delay_inflation(Ls, p, float(alpha),
+                                                  event_delays)
+    # wire noise is paid per gossip event (the skipped local steps exchange
+    # nothing), so it composes before the local-step exponent
     eps = wire_quantization_eps(wire_dtype)
-    if eps == 0.0:
-        return rho
-    root = math.sqrt(max(rho, 0.0))
-    return (root + eps * (1.0 + root)) ** 2
+    if eps > 0.0:
+        root = math.sqrt(max(rho, 0.0))
+        rho = (root + eps * (1.0 + root)) ** 2
+    if L_steps > 1:
+        rho = rho ** (1.0 / L_steps)
+    return float(rho)
+
+
+def stale_alpha_rescale(
+    laplacians: np.ndarray,
+    probs: np.ndarray,
+    alpha: float,
+    staleness=1,
+    local_steps: int = 1,
+) -> Tuple[float, float]:
+    """Damping scale ``s ∈ (0, 1]`` on the solved α that minimizes the
+    staleness-composed ρ, and the ρ at that scale.
+
+    The MATCHA solver picks α for the *eager* dynamics; under a k-deep
+    pipeline the same α overdrives — high-gain modes (``αμ`` near or past
+    1) oscillate under delayed feedback and the composed ρ can exceed 1
+    (a real divergence the MC simulator reproduces, not a bound artifact).
+    The classic fix is to damp the mixing weight for the delay; this is
+    the 1-D solve that does it against the same closed form the predictor
+    reports.  The executor applies the scale through the per-step flag
+    row (every backend's edge weight is ``α·flag_j``, so scaling the row
+    executes ``s·α`` exactly — the same value-level seam elastic
+    membership's ``alpha_scale`` re-plans ride, and for the same reason:
+    the as-built schedule, its fingerprint, and every checkpoint stay
+    untouched).  Returns ``(1.0, ρ_eager_composed)`` unchanged whenever
+    the effective event delay is 1 — the committed k=1 pipeline is never
+    re-damped.
+    """
+    delays = normalize_staleness(staleness)
+    L_steps = int(local_steps)
+    base = stale_contraction_rho(laplacians, probs, alpha,
+                                 overlap="1step", staleness=delays,
+                                 local_steps=L_steps)
+    if max(-(-d // L_steps) for d in delays) <= 1:
+        return 1.0, float(base)
+    from scipy.optimize import minimize_scalar
+
+    def rho_at(s: float) -> float:
+        return stale_contraction_rho(laplacians, probs, float(alpha) * s,
+                                     overlap="1step", staleness=delays,
+                                     local_steps=L_steps)
+
+    res = minimize_scalar(rho_at, bounds=(1e-3, 1.0), method="bounded",
+                          options={"xatol": 1e-4})
+    scale, rho = float(res.x), float(res.fun)
+    if base <= rho:  # the solved α was already optimal under this delay
+        return 1.0, float(base)
+    return scale, rho
 
 
 def wire_disagreement_floor(wire_dtype, param_scale: float = 1.0) -> float:

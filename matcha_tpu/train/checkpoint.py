@@ -30,7 +30,8 @@ import orbax.checkpoint as ocp
 from .state import TrainState
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "schedule_fingerprint", "load_membership_sidecar"]
+           "saved_mix_pending_shape", "schedule_fingerprint",
+           "load_membership_sidecar"]
 
 
 def _manager(directory: str) -> ocp.CheckpointManager:
@@ -97,7 +98,12 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
     # pool occupancy restore into a run at another: the arrays are the
     # full static pool either way, and the sidecar says who the rows
     # belonged to.
-    state = state.replace(telemetry=(), membership=())
+    # mix_ages joins the stripped set (DESIGN.md §20): the pending ring's
+    # age counters are reconstructible from the step cursor's ring
+    # arithmetic (loop.py's reconcile rebuilds them), and stripping keeps
+    # checkpoint pytrees identical across every staleness setting — the
+    # in-flight deltas themselves (mix_pending) are real state and stay.
+    state = state.replace(telemetry=(), membership=(), mix_ages=())
     mgr = _manager(directory)
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
@@ -145,6 +151,46 @@ def latest_step(directory: str) -> Optional[int]:
     return step
 
 
+def saved_mix_pending_shape(directory: str,
+                            epoch: Optional[int] = None) -> Optional[tuple]:
+    """Shape of the ``mix_pending`` array a checkpoint holds, or ``None``.
+
+    Resume cannot know the writing run's pipeline depth from config alone
+    (``--staleness`` may have changed between runs): the restore template's
+    probe slot must match what orbax stored — ``[N, D]`` from a one-step
+    run, worker-major ``[N, K', D]`` from a staleness-K′ ring (the depth is
+    axis 1, like every TrainState leaf the worker axis leads), absent from
+    an eager run — so the loop peeks the checkpoint metadata first and
+    reconciles the restored pipeline against this run's contract
+    afterwards (``loop._reconcile_mix_pending``).  Metadata-only: no array data is
+    read.  Returns ``None`` for eager checkpoints and for checkpoint
+    layouts whose metadata cannot be read (the caller falls back to the
+    historical ``[N, D]`` probe).
+    """
+    if not os.path.isdir(directory):
+        return None
+    try:
+        from etils import epath
+
+        step = epoch if epoch is not None else latest_step(directory)
+        if step is None:
+            return None
+        # path-level handler metadata: a fresh CheckpointManager has no
+        # handler registry until a typed restore runs, so its
+        # item_metadata() answers None — the StandardCheckpointHandler
+        # reads the written _METADATA directly
+        meta = ocp.StandardCheckpointHandler().metadata(
+            epath.Path(os.path.abspath(directory)) / str(int(step))
+            / "default")
+        entry = meta.get("mix_pending") if hasattr(meta, "get") else None
+        shape = getattr(entry, "shape", None)
+        return None if shape is None else tuple(int(s) for s in shape)
+    # graftlint: disable=GL006 — a metadata layout this reader predates
+    # falls back to the historical probe shape; restore still validates
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def restore_checkpoint(directory: str, template: TrainState,
                        epoch: Optional[int] = None, schedule=None):
     """Restore into the structure of ``template`` (shapes/dtypes must match).
@@ -160,14 +206,16 @@ def restore_checkpoint(directory: str, template: TrainState,
     step = epoch if epoch is not None else mgr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {directory}")
-    # telemetry is per-epoch scratch and membership is sidecar-persisted
-    # occupancy — NEITHER is in the checkpoint pytree (save strips both) —
-    # strip them from any template here too, so a caller holding a live
-    # state restores cleanly, and pass the caller's own slots back through
-    # unchanged
+    # telemetry is per-epoch scratch, membership is sidecar-persisted
+    # occupancy, and mix_ages is step-cursor-reconstructible ring
+    # bookkeeping — NONE is in the checkpoint pytree (save strips all
+    # three) — strip them from any template here too, so a caller holding
+    # a live state restores cleanly, and pass the caller's own slots back
+    # through unchanged
     caller_telemetry = template.telemetry
     caller_membership = template.membership
-    template = template.replace(telemetry=(), membership=())
+    caller_mix_ages = template.mix_ages
+    template = template.replace(telemetry=(), membership=(), mix_ages=())
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
     try:
         state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
@@ -177,11 +225,14 @@ def restore_checkpoint(directory: str, template: TrainState,
         # that carries the extra slot (even an empty `()` one — the field
         # name is still a dict key).  Retry through progressively older
         # templates, newest plausible first:
-        #   1. minus `membership` (PR7–PR8: has the telemetry slot, pre-
-        #      elastic) — occupancy is sidecar state, never in the pytree;
-        #   2. minus `membership` and `telemetry` (PR4–PR6: has
-        #      mix_pending, pre-obs);
-        #   3. minus all three plus `mix_pending` (pre-PR4 legacy): a
+        #   1. minus `mix_ages` (PR9–PR13: has membership's key, pre-
+        #      staleness) — ages are reconstructed bookkeeping either way;
+        #   2. minus `mix_ages` and `membership` (PR7–PR8: has the
+        #      telemetry slot, pre-elastic) — occupancy is sidecar state,
+        #      never in the pytree;
+        #   3. minus those and `telemetry` (PR4–PR6: has mix_pending,
+        #      pre-obs);
+        #   4. minus all four plus `mix_pending` (pre-PR4 legacy): a
         #      checkpoint from before the overlapped pipeline truthfully
         #      carries no in-flight delta, and `_reconcile_mix_pending` in
         #      train/loop.py primes a zero delta if this run resumes with
@@ -191,8 +242,9 @@ def restore_checkpoint(directory: str, template: TrainState,
         fields = {f.name: getattr(abstract, f.name)
                   for f in dataclasses.fields(template)}
         state = None
-        for drop in (("membership",), ("membership", "telemetry"),
-                     ("membership", "telemetry", "mix_pending")):
+        for drop in (("mix_ages",), ("mix_ages", "membership"),
+                     ("mix_ages", "membership", "telemetry"),
+                     ("mix_ages", "membership", "telemetry", "mix_pending")):
             older = {k: v for k, v in fields.items() if k not in drop}
             try:
                 restored = mgr.restore(
@@ -210,7 +262,8 @@ def restore_checkpoint(directory: str, template: TrainState,
             raise e  # none of the known generations: the original error
             # names the real mismatch
     state = state.replace(telemetry=caller_telemetry,
-                          membership=caller_membership)
+                          membership=caller_membership,
+                          mix_ages=caller_mix_ages)
     mgr.close()
     if schedule is not None:
         cursor = int(np.asarray(state.step))

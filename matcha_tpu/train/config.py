@@ -90,6 +90,15 @@ class TrainConfig:
     # roofline->selection loop for a real run.  Journaled in the
     # `backend` decision event either way.
     gossip_measured_vs_ceiling: Optional[float] = None
+    # ... or extract that ratio from an artifact instead of typing it: a
+    # run journal carrying `bench` roofline records (obs_tpu.py roofline
+    # --journal), a bench_live_r*.json capture, or a raw roofline-report
+    # JSON (plan.cost.load_measured_vs_ceiling resolves all three; the
+    # provenance is journaled in the `backend` decision event).  An
+    # unusable artifact raises — auto must never promote on a ratio that
+    # silently failed to load.  The explicit ratio flag wins when both
+    # are set.
+    gossip_measured_source: Optional[str] = None
     # overlapped gossip pipeline (DESIGN.md §11): "1step" issues each step's
     # exchange via begin_mix and consumes it at the next step, so XLA can
     # hide ICI traffic under the next forward/backward; "off" is the eager
@@ -97,6 +106,25 @@ class TrainConfig:
     # gradient update joins consensus one round late — contraction effect
     # predicted by `plan_tpu.py rho --overlap 1step`.
     overlap: str = "off"  # off|1step
+    # bounded-staleness pipeline depth K (DESIGN.md §20): with overlap
+    # "1step", in-flight mixing deltas age through a static-shape
+    # [K, N, D] pending ring — issued at step t, consumed at t+K — so a
+    # fast worker proceeds K steps before it needs a straggler's delta.
+    # K=1 is the committed one-step pipeline, bitwise.  For K >= 2 the
+    # loop damps the executed mixing weight for the delayed dynamics
+    # (plan.spectral.stale_alpha_rescale — the eagerly-solved α oscillates
+    # under deep delay; the damping rides the flag row like elastic
+    # alpha_scale, so schedules, fingerprints, and checkpoints are
+    # untouched) and the drift monitor predicts with the staleness-
+    # composed ρ (`plan_tpu.py rho --staleness K`).
+    staleness: int = 1
+    # local SGD steps per gossip exchange (DESIGN.md §20): the flag stream
+    # is statically thinned to every L-th row (skipped steps mix by I and
+    # move zero wire bytes), so consensus contracts at rho^(1/L) per step
+    # while gossip cost is paid 1/L as often.  Composes with staleness:
+    # delays count in gossip-event units ceil(K/L), so local_steps >= K
+    # telescopes exactly like the one-step pipeline.
+    local_steps: int = 1
     # dtype of the exchanged tensors at the gossip boundary: "bf16" halves
     # bytes_per_step on every backend (ppermute blocks, gathered rows, the
     # MXU operand pass) while master params and accumulation stay f32;
@@ -246,6 +274,15 @@ class TrainConfig:
         if self.overlap not in ("off", "1step"):
             raise ValueError(
                 f"overlap must be 'off' or '1step', got {self.overlap!r}")
+        if self.staleness < 1:
+            raise ValueError(f"staleness must be >= 1, got {self.staleness}")
+        if self.staleness > 1 and self.overlap != "1step":
+            raise ValueError(
+                "staleness > 1 needs overlap='1step': the eager schedule "
+                "has no pending ring to age mixing deltas through")
+        if self.local_steps < 1:
+            raise ValueError(
+                f"local_steps must be >= 1, got {self.local_steps}")
         if self.wire_dtype not in ("f32", "bf16"):
             raise ValueError(
                 f"wire_dtype must be 'f32' or 'bf16', got {self.wire_dtype!r}")

@@ -176,6 +176,19 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                                     schedule.num_matchings)
     run_flags = (np.asarray(schedule.flags, np.float32) * faults.link_up
                  if faults is not None else schedule.flags)
+    if config.local_steps > 1:
+        # local SGD steps (DESIGN.md §20): gossip fires only every L-th
+        # step.  Static thinning of the flag stream — an all-zero flag row
+        # is identity mixing on every backend and moves zero wire bytes,
+        # so the communicators, telemetry, and the comm-split timer need
+        # no extra machinery (the same trick link outages ride above).
+        # The schedule fingerprint stays the as-built stream: thinning is
+        # config-derived, so a resume re-derives it identically.
+        keep = (np.arange(len(run_flags)) % config.local_steps
+                == 0).astype(np.float32)
+        # graftlint: disable=GL001 — thinning 0/1 plan weights on host
+        # numpy, same shape algebra as the link_up fold above
+        run_flags = np.asarray(run_flags, np.float32) * keep[:, None]
     # checkpoints always fingerprint the *as-built* schedule: recovery may
     # re-derive α (rebinding `schedule`), but no config could reproduce that
     # α at resume time — fingerprinting it would leave every post-recovery
@@ -234,10 +247,24 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if config.communicator == "decen":
         from ..communicator.decen import resolve_gossip_backend
 
+        # the gate's measured input: the explicit ratio flag, else the
+        # ratio extracted from a --gossip-measured-source artifact (a
+        # journal's roofline records, a bench_live capture, or a raw
+        # roofline report) — the PR 13 follow-on that closes the
+        # roofline→selection loop without an operator transcribing numbers
+        measured = config.gossip_measured_vs_ceiling
+        measured_src = None
+        if measured is None and config.gossip_measured_source:
+            from ..plan.cost import load_measured_vs_ceiling
+
+            measured, measured_src = load_measured_vs_ceiling(
+                config.gossip_measured_source)
         backend_decision = resolve_gossip_backend(
             schedule, mesh, requested=config.gossip_backend,
             wire_dtype=config.wire_dtype,
-            measured_vs_ceiling=config.gossip_measured_vs_ceiling)
+            measured_vs_ceiling=measured)
+        if measured_src is not None:
+            backend_decision["measured_source"] = measured_src
         gossip_backend = backend_decision["chosen"]
 
     def _make_comm(ratio: float):
@@ -274,8 +301,31 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     state, flattener = init_train_state(
         model, input_shape, config.num_workers, optimizer, communicator,
         seed=config.seed, overlap=config.overlap,
+        staleness=config.staleness,
         sync_init=config.sync_init,
     )
+
+    # bounded-staleness α damping (DESIGN.md §20): the MATCHA α is solved
+    # for the eager dynamics and overdrives under a k-deep pipeline
+    # (delayed overcompensation oscillates — ρ_eff > 1, MC-confirmed);
+    # re-solve the damping scale against the delayed closed form and
+    # execute it through the per-step flag row — the same value-level
+    # seam as elastic alpha_scale, so the schedule, its fingerprint, and
+    # every checkpoint stay untouched.  Recomputed by _build_programs on
+    # every rebuild, so a recovery-path α re-derivation re-damps
+    # consistently.  Only the decen communicator is modeled (the same
+    # scope as the drift monitor); other communicators run undamped.
+    def _stale_scale() -> float:
+        if config.staleness > 1 and config.communicator == "decen":
+            from ..plan.spectral import stale_alpha_rescale
+
+            s, _ = stale_alpha_rescale(
+                schedule.laplacians(), schedule.probs, float(schedule.alpha),
+                staleness=config.staleness, local_steps=config.local_steps)
+            return float(s)
+        return 1.0
+
+    stale_scale = _stale_scale()
 
     # in-graph telemetry (DESIGN.md §14): static per-matching exchange
     # accounting baked into the step; the accumulator rides TrainState and
@@ -288,7 +338,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                    else [[] for _ in schedule.decomposed])
         tel_spec = make_telemetry_spec(
             tel_dec, flattener.dim, wire_dtype=config.wire_dtype,
-            overlap=config.overlap)
+            overlap=config.overlap, staleness=config.staleness)
 
     def _fresh_telemetry():
         """A new accumulator with the *state's* sharding: an unplaced
@@ -297,7 +347,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         epoch (the retrace watch caught exactly this).  Fresh buffers each
         time — the scanned epoch donates the state, so a reused template
         would be invalidated by the very epoch that consumed it."""
-        tel = Telemetry.zeros(config.num_workers)
+        tel = Telemetry.zeros(config.num_workers, config.staleness)
         return shard_workers(tel, mesh) if mesh is not None else tel
 
     def _fresh_membership():
@@ -349,14 +399,16 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         state = shard_workers(state, mesh)
 
     def _make_step(comm):
-        # reads `optimizer`, `lr_schedule`, and `faults` at call time: the
-        # recovery path rebinds them (LR backoff, consumed NaN events) and
-        # rebuilds, so retried epochs compile against the updated program
+        # reads `optimizer`, `lr_schedule`, `faults`, and `stale_scale` at
+        # call time: the recovery path rebinds them (LR backoff, consumed
+        # NaN events, re-damped α) and rebuilds, so retried epochs compile
+        # against the updated program
         return make_train_step(
             model, optimizer, comm, flattener, run_flags,
             dropout=False, lr_schedule=lr_schedule,
             grad_chunk=config.grad_chunk, faults=faults,
-            overlap=config.overlap, telemetry=tel_spec,
+            overlap=config.overlap, staleness=config.staleness,
+            stale_alpha_scale=stale_scale, telemetry=tel_spec,
             elastic=elastic_ctl is not None,
         )
 
@@ -374,7 +426,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         the reference's timer-around-sendrecv cannot bracket it.  Costs one
         extra gossip chain per epoch; measure_comm_split=False disables."""
         nonlocal lr_schedule, optimizer, communicator, step_fn, scan_step, \
-            comm_timer
+            comm_timer, stale_scale
+        stale_scale = _stale_scale()
         lr_schedule = _make_lr()
         optimizer = make_optimizer(lr_schedule, config.momentum,
                                    config.weight_decay, config.nesterov)
@@ -423,24 +476,32 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if resume_dir is None:
         resume_dir = config.resume
     if resume_dir is not None:
-        # --overlap may differ from the run that wrote the checkpoint, and
-        # orbax restores whatever mix_pending the *checkpoint* holds only if
-        # the template has an array slot for it (a () template silently
-        # drops a saved delta — verified against orbax directly).  Restore
-        # through an always-array probe template: a 1step checkpoint's
-        # in-flight delta comes back as the array, an eager checkpoint's ()
-        # comes back as () — then reconcile with this run's overlap mode.
-        pend0 = jnp.zeros((config.num_workers, flattener.dim), jnp.float32)
+        # --overlap / --staleness may differ from the run that wrote the
+        # checkpoint, and orbax restores whatever mix_pending the
+        # *checkpoint* holds only if the template has an array slot of the
+        # saved SHAPE for it (a () template silently drops a saved delta —
+        # verified against orbax directly; a wrong-shape probe fails the
+        # restore).  Peek the checkpoint's own mix_pending shape ([N, D]
+        # from a one-step run, [N, K', D] from a staleness ring, absent
+        # from an eager run), restore through a probe of that shape, then
+        # reconcile with this run's overlap/staleness contract.
+        from .checkpoint import saved_mix_pending_shape
+
+        probe_shape = saved_mix_pending_shape(resume_dir) \
+            or (config.num_workers, flattener.dim)
+        pend0 = jnp.zeros(probe_shape, jnp.float32)
         if mesh is not None:
             pend0 = shard_workers(pend0, mesh)  # match the state's sharding
         # telemetry is never checkpointed (per-epoch scratch): the
         # save/restore pair strips it internally, and the caller's slot
-        # passes through — re-primed fresh below either way
+        # passes through — re-primed fresh below either way (mix_ages
+        # rides the same strip; the reconcile rebuilds it from the cursor)
         state, last_epoch = restore_checkpoint(
             resume_dir, state.replace(mix_pending=pend0), schedule=schedule)
         start_epoch = last_epoch + 1
         state = _reconcile_mix_pending(state, config.overlap, communicator,
-                                       flattener, config.num_workers)
+                                       flattener, config.num_workers,
+                                       staleness=config.staleness)
         if elastic_ctl is not None:
             # reconstruct the controller state this boundary had (the trace
             # replays deterministically — byte-identical resume is pinned by
@@ -549,17 +610,22 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         else:
             worker_alive = fault_alive * member_alive
         pred = compose_predicted_rho(
-            schedule.laplacians(), schedule.probs, plan_alpha,
+            # the plan in force is the staleness-damped α: the executor
+            # scales the flag row by stale_scale, so the monitor must
+            # predict the contraction of the mixing that actually runs
+            schedule.laplacians(), schedule.probs, plan_alpha * stale_scale,
             overlap=config.overlap, wire_dtype=config.wire_dtype,
             worker_alive=worker_alive,
             link_up=(np.asarray(faults.expected_link_up(), np.float64)
                      if faults is not None else None),
+            staleness=config.staleness, local_steps=config.local_steps,
         )
         pred.update(steps_per_epoch=int(bpe),
                     tolerance=float(config.drift_tolerance),
                     patience=int(config.drift_patience),
                     plan_alpha=float(plan_alpha),
-                    executed_alpha=float(schedule.alpha))
+                    stale_alpha_scale=float(stale_scale),
+                    executed_alpha=float(schedule.alpha) * float(stale_scale))
         return pred
 
     predicted = None
@@ -982,18 +1048,37 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         epoch += 1
 
     if config.overlap == "1step":
-        # drain the pipeline: apply the final in-flight delta so the
-        # returned parameters are the fully-mixed state — after this, the
-        # pipelined chain has realized exactly the same W-product as the
-        # eager schedule would have (base.py: run_overlapped).  Inside the
-        # run the pending delta stays in TrainState (checkpoints resume the
-        # pipeline without a re-prime); only the result handed back drains.
-        @jax.jit
-        def _drain(s):
-            flat = communicator.apply_mix(
-                flattener.flatten(s.params), s.mix_pending)
-            return s.replace(params=flattener.unflatten(flat),
-                             mix_pending=jnp.zeros_like(s.mix_pending))
+        # drain the pipeline: apply the in-flight delta(s) so the returned
+        # parameters are the fully-mixed state — at staleness 1 the
+        # pipelined chain has then realized exactly the same W-product as
+        # the eager schedule (base.py: run_overlapped); a deeper ring
+        # flushes oldest-first (base.py: run_pipelined's drain order).
+        # Inside the run the pending state stays in TrainState
+        # (checkpoints resume the pipeline without a re-prime); only the
+        # result handed back drains.
+        if config.staleness == 1:
+            @jax.jit
+            def _drain(s):
+                flat = communicator.apply_mix(
+                    flattener.flatten(s.params), s.mix_pending)
+                return s.replace(params=flattener.unflatten(flat),
+                                 mix_pending=jnp.zeros_like(s.mix_pending))
+        else:
+            # slot order is cursor arithmetic — a host int at this
+            # boundary (training is over; the sync already happened)
+            cursor = int(np.asarray(state.step))
+            order = [(cursor + i) % config.staleness
+                     for i in range(config.staleness)]
+
+            @jax.jit
+            def _drain(s):
+                flat = flattener.flatten(s.params)
+                for i in order:
+                    flat = communicator.apply_mix(flat, s.mix_pending[:, i])
+                return s.replace(
+                    params=flattener.unflatten(flat),
+                    mix_pending=jnp.zeros_like(s.mix_pending),
+                    mix_ages=jnp.full_like(s.mix_ages, -1))
 
         if cost_ledger is not None:
             cost_ledger.observe("drain", _drain, state)
@@ -1023,27 +1108,70 @@ def _config_snapshot(config: TrainConfig) -> Dict:
 
 
 def _reconcile_mix_pending(state, overlap: str, communicator, flattener,
-                           num_workers: int):
-    """Align a restored state's in-flight mix delta with this run's
-    ``--overlap`` mode.
+                           num_workers: int, staleness: int = 1):
+    """Align a restored state's in-flight mix delta(s) with this run's
+    ``--overlap`` / ``--staleness`` contract.
 
     An eager checkpoint carries no delta (``()``): resuming pipelined
-    primes the zero delta the first step consumes; resuming eagerly keeps
-    the empty slot.  A pipelined checkpoint carries a real ``[N, D]``
-    delta: resuming pipelined keeps it (the pipeline continues seamlessly);
-    resuming eagerly *drains* it into the parameters — silently dropping it
-    would lose the final issued mixing step.
+    primes the zero delta/ring the first step consumes; resuming eagerly
+    keeps the empty slot.  A pipelined checkpoint carries real in-flight
+    state — ``[N, D]`` from a one-step run, ``[N, K', D]`` from a
+    staleness-K′ ring:
+
+    * same depth (K = K′): the pipeline continues seamlessly; ring age
+      counters (never checkpointed) are rebuilt from the step cursor's
+      ring arithmetic — slot s holds the delta issued at the last step
+      ≡ s (mod K) before the cursor.
+    * resuming eagerly: every in-flight delta *drains* into the
+      parameters, oldest-first — silently dropping them would lose issued
+      mixing steps.
+    * a depth change (K ≠ K′, either direction): the pipeline is
+      *flushed* at the boundary — all saved deltas drain oldest-first
+      (their relative ages collapse to "now", a one-time perturbation no
+      worse than the drain any exit performs), then a fresh zero pipeline
+      primes at the new depth.  Slot arithmetic is mod-K of the cursor,
+      so re-basing in place would mis-age every delta; the flush is the
+      honest reconciliation.
     """
     pend = state.mix_pending
+    ring_on = overlap == "1step" and staleness > 1
+    fresh_pend = (
+        jnp.zeros((num_workers, staleness, flattener.dim), jnp.float32)
+        if ring_on
+        else jnp.zeros((num_workers, flattener.dim), jnp.float32)
+        if overlap == "1step" else ())
+    fresh_ages = (jnp.full((num_workers, staleness), -1, jnp.int32)
+                  if ring_on else ())
     if not hasattr(pend, "shape"):
-        return state.replace(
-            mix_pending=jnp.zeros((num_workers, flattener.dim), jnp.float32)
-            if overlap == "1step" else ())
-    if overlap == "1step":
-        return state
-    flat = communicator.apply_mix(flattener.flatten(state.params),
-                                  jnp.asarray(pend))
-    return state.replace(params=flattener.unflatten(flat), mix_pending=())
+        return state.replace(mix_pending=fresh_pend, mix_ages=fresh_ages)
+    pend = jnp.asarray(pend)
+    cursor = int(np.asarray(state.step))
+    saved_k = int(pend.shape[1]) if pend.ndim == 3 else 1
+
+    if overlap == "1step" and saved_k == staleness:
+        if not ring_on:
+            return state.replace(mix_ages=())  # one-step: seamless as ever
+        # same-depth ring: rebuild ages from the cursor (slot s was issued
+        # at the last step t' < cursor with t' ≡ s (mod K); empty before
+        # the warmup filled it)
+        ages = np.full((num_workers, staleness), -1, np.int64)
+        for s in range(staleness):
+            issued = cursor - 1 - ((cursor - 1 - s) % staleness)
+            if issued >= 0:
+                ages[:, s] = cursor - issued
+        return state.replace(mix_ages=jnp.asarray(ages, jnp.int32))
+
+    # drain oldest-first: slot (cursor + i) mod K' holds the delta issued
+    # K'−i steps ago
+    flat = flattener.flatten(state.params)
+    if pend.ndim == 2:
+        flat = communicator.apply_mix(flat, pend)
+    else:
+        for i in range(saved_k):
+            flat = communicator.apply_mix(
+                flat, pend[:, (cursor + i) % saved_k])
+    return state.replace(params=flattener.unflatten(flat),
+                         mix_pending=fresh_pend, mix_ages=fresh_ages)
 
 
 def _make_comm_timer(communicator, flattener, sample_steps: int = 32,

@@ -43,12 +43,27 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     comm_carry: Any
     step: jax.Array  # scalar int32 — also the schedule cursor (ckpt-critical)
-    # in-flight mixing delta of the overlapped pipeline (DESIGN.md §11):
-    # f32[N, D] when overlap is on (the exchange issued at step t−1, consumed
-    # at step t), the empty tuple when off — the eager path's pytree and
-    # checkpoints are unchanged.  Part of the state on purpose: the pipeline
-    # survives epoch boundaries and checkpoint/resume without a re-prime.
+    # in-flight mixing delta(s) of the overlapped pipeline (DESIGN.md §11,
+    # §20): f32[N, D] at overlap="1step" with staleness 1 (the exchange
+    # issued at step t−1, consumed at step t), a f32[N, K, D] pending RING
+    # at staleness K ≥ 2 (slot t mod K holds the exchange issued at step
+    # t−K; deltas age K steps before they are consumed), the empty tuple
+    # when off — the eager path's pytree and checkpoints are unchanged.
+    # Worker-major on purpose — every state leaf is, which is what lets
+    # mask_worker_rows / shard_workers / state_finite_rows treat the ring
+    # like any other per-worker slab (the chain-level
+    # ``Communicator.run_pipelined`` uses the scan-natural [K, N, D]).
+    # Part of the state on purpose: the pipeline survives epoch boundaries
+    # and checkpoint/resume without a re-prime.
     mix_pending: Any = ()
+    # per-worker, per-slot age counters of the pending ring (DESIGN.md
+    # §20): i32[N, K] when staleness ≥ 2, the empty tuple otherwise.
+    # Traced values riding the state — heal/leave events mark a worker's
+    # slots empty (−1) without any shape change, and the telemetry
+    # consumed-age histogram reads them — NEVER checkpointed (checkpoint.py
+    # strips them like telemetry; resume rebuilds ages from the step
+    # cursor's ring arithmetic).
+    mix_ages: Any = ()
     # device-side step telemetry (DESIGN.md §14): an ``obs.Telemetry``
     # scalar pytree when observability is on, the empty tuple when off.
     # Carried in the state so the scanned epoch accumulates it without any
@@ -93,13 +108,16 @@ def init_train_state(
     seed: int = 0,
     sync_init: bool = True,
     overlap: str = "off",
+    staleness: int = 1,
 ) -> tuple[TrainState, WorkerFlattener]:
     """Per-worker independent inits (torch per-rank ``seed+rank``,
     train_mpi.py:61) followed by the reference's initial AllReduce sync.
 
     ``overlap="1step"`` primes ``mix_pending`` with the zero delta the
-    pipelined step consumes at step 0; ``"off"`` leaves it the empty tuple
-    so the eager state pytree (and its checkpoints) are unchanged."""
+    pipelined step consumes at step 0; ``staleness=K ≥ 2`` primes the
+    ``[N, K, D]`` pending ring plus its all-empty (−1) age counters;
+    ``"off"`` leaves both the empty tuple so the eager state pytree (and
+    its checkpoints) are unchanged."""
     dummy = jnp.zeros((1,) + tuple(input_shape), jnp.float32)
 
     def init_one(key):
@@ -114,14 +132,22 @@ def init_train_state(
         flat = allreduce_mean(flattener.flatten(params))
         params = flattener.unflatten(flat)
 
+    if staleness < 1:
+        raise ValueError(f"staleness must be >= 1, got {staleness}")
+    ring_on = overlap == "1step" and staleness > 1
     state = TrainState(
         params=params,
         batch_stats=batch_stats,
         opt_state=optimizer.init(params),
         comm_carry=communicator.init(flattener.flatten(params)),
         step=jnp.zeros((), jnp.int32),
-        mix_pending=(jnp.zeros((num_workers, flattener.dim), jnp.float32)
-                     if overlap == "1step" else ()),
+        mix_pending=(
+            jnp.zeros((num_workers, staleness, flattener.dim), jnp.float32)
+            if ring_on
+            else jnp.zeros((num_workers, flattener.dim), jnp.float32)
+            if overlap == "1step" else ()),
+        mix_ages=(jnp.full((num_workers, staleness), -1, jnp.int32)
+                  if ring_on else ()),
     )
     return state, flattener
 
@@ -137,6 +163,8 @@ def make_train_step(
     grad_chunk: Optional[int] = None,
     faults=None,
     overlap: str = "off",
+    staleness: int = 1,
+    stale_alpha_scale: float = 1.0,
     telemetry=None,
     elastic: bool = False,
 ):
@@ -184,6 +212,22 @@ def make_train_step(
     every delta has zero column-mean.  Requires ``state.mix_pending`` to be
     a ``zeros([N, D])`` (``train/loop.py`` primes it).
 
+    ``staleness`` (K ≥ 1, with ``overlap="1step"``): the bounded-staleness
+    contract consume-at-≤t+K (DESIGN.md §20).  K = 1 compiles the exact
+    committed one-step path above; K ≥ 2 ages in-flight deltas through the
+    static-shape ``[N, K, D]`` ring in ``state.mix_pending`` — step t
+    applies slot ``t mod K`` (the exchange issued at t−K), then issues its
+    own into that slot — with ``state.mix_ages`` (i32[N, K]) tracking each
+    row's age as a traced value (−1 = empty: warmup, healed, or vacant).
+    Every membership/heal transition is a value update; shapes never
+    change, so the zero-retrace contract extends to the ring unchanged.
+    ``stale_alpha_scale``: trace-time damping of the executed mixing
+    weight for the delayed dynamics (``plan.spectral.stale_alpha_rescale``
+    — the solved α overdrives under a deep pipeline); it scales the
+    communicator's flag row exactly like elastic ``alpha_scale`` does, and
+    composes with it.  Telemetry's flag accounting stays unscaled — the
+    matchings still fire; only their weight is damped.
+
     ``telemetry``: optional ``obs.TelemetrySpec`` — when given *and* the
     incoming ``state.telemetry`` is a real ``obs.Telemetry`` pytree, each
     step folds its counters (disagreement, wire bytes at the configured
@@ -212,6 +256,21 @@ def make_train_step(
     if overlap not in ("off", "1step"):
         raise ValueError(f"overlap must be 'off' or '1step', got {overlap!r}")
     overlap_on = overlap == "1step"
+    staleness = int(staleness)
+    if staleness < 1:
+        raise ValueError(f"staleness must be >= 1, got {staleness}")
+    if staleness > 1 and not overlap_on:
+        raise ValueError("staleness > 1 needs overlap='1step': the eager "
+                         "path has no pending ring to age deltas through")
+    ring_on = overlap_on and staleness > 1
+    if not stale_alpha_scale > 0:
+        raise ValueError(f"stale_alpha_scale must be > 0, got "
+                         f"{stale_alpha_scale}")
+    # the α damping is a trace-time constant scale on the communicator's
+    # flag row (every backend's edge weight is α·flag_j); telemetry keeps
+    # reading the unscaled flags_arr — the schedule still fires
+    comm_flags_arr = (flags_arr * np.float32(stale_alpha_scale)
+                      if stale_alpha_scale != 1.0 else flags_arr)
     if faults is not None:
         if faults.alive.shape != (flags_arr.shape[0], n_workers):
             raise ValueError(
@@ -279,16 +338,18 @@ def make_train_step(
         t = jnp.minimum(state.step, flags_arr.shape[0] - 1)
         comm_carry = state.comm_carry
         mix_pending = state.mix_pending
+        mix_ages = state.mix_ages
+        ring_dropped = jnp.zeros((), jnp.float32)
         # elastic membership (DESIGN.md §16): the pool mask and the α
         # re-plan arrive as runtime values riding the state — the same
         # compiled program serves every live set.  Every backend's per-step
         # edge weight is α·flag_j, so scaling the flag row by α′/α executes
         # the re-derived α′ exactly, on dense/gather/skip/folded alike.
         member = None
-        comm_flags_t = flags_arr[t]
+        comm_flags_t = comm_flags_arr[t]
         if elastic and not isinstance(state.membership, tuple):
             member = state.membership.alive
-            comm_flags_t = flags_arr[t] * state.membership.alpha_scale
+            comm_flags_t = comm_flags_arr[t] * state.membership.alpha_scale
         alive = None
         if faults is not None or member is not None:
             from ..resilience.runtime import (
@@ -322,15 +383,56 @@ def make_train_step(
                 comm_carry = mask_worker_rows(comm_carry, keep, n)
                 if overlap_on:
                     # a healed worker restarts from the survivors' average:
-                    # the delta issued from its pre-heal parameters is stale
-                    # algorithm state like momentum, and is dropped with it
+                    # the delta(s) issued from its pre-heal parameters are
+                    # stale algorithm state like momentum, and are dropped
+                    # with it — at staleness K the worker-major ring masks
+                    # through the same call (its [N, K, D] rows ARE worker
+                    # rows), with the slots marked empty and the real
+                    # deltas dropped counted for telemetry
+                    if ring_on:
+                        gone = (mix_ages >= 0) & (keep[:, None] <= 0)
+                        ring_dropped = ring_dropped + jnp.sum(
+                            gone.astype(jnp.float32))
+                        mix_ages = jnp.where(keep[:, None] > 0, mix_ages, -1)
                     mix_pending = mask_worker_rows(mix_pending, keep, n)
                 # BN running stats can be neither kept (poisoned/stale) nor
                 # zero-reset (variance 0 is not neutral): the healed worker
                 # adopts the donors' statistics along with their parameters
                 new_stats = heal_worker_stat_rows(new_stats, healed,
                                                   alive * keep, n)
-        if overlap_on:
+        consumed_age = None
+        if ring_on:
+            # bounded staleness (DESIGN.md §20): consume ring slot t mod K
+            # — the exchange issued at step t−K (zero through the K-step
+            # warmup) — then issue this step's exchange into the same
+            # slot.  The issued collectives have no consumer for K steps,
+            # so XLA is free to run them under the next K
+            # forward/backwards; ages are traced values, shapes never
+            # change (the zero-retrace contract).
+            slot = jax.lax.rem(state.step, jnp.int32(staleness))
+            mix_ages = jnp.where(mix_ages >= 0, mix_ages + 1, mix_ages)
+            consumed_age = jax.lax.dynamic_index_in_dim(
+                mix_ages, slot, 1, keepdims=False)
+            flat = communicator.apply_mix(
+                flat, jax.lax.dynamic_index_in_dim(
+                    mix_pending, slot, 1, keepdims=False))
+            if alive is None:
+                delta, carry = communicator.begin_mix(
+                    flat, comm_carry, comm_flags_t)
+                issued = jnp.zeros((n,), jnp.int32)
+            else:
+                delta, carry = begin_mix_quarantined(
+                    communicator.begin_mix, flat, comm_carry, comm_flags_t,
+                    alive, gate=row_finite)
+                # dead/non-finite rows issued nothing real (their delta
+                # rows are zeroed above): their slot entries stay empty
+                issued = jnp.where((alive > 0) & (row_finite > 0),
+                                   0, -1).astype(jnp.int32)
+            mix_pending = jax.lax.dynamic_update_index_in_dim(
+                mix_pending, delta, slot, 1)
+            mix_ages = jax.lax.dynamic_update_index_in_dim(
+                mix_ages, issued, slot, 1)
+        elif overlap_on:
             # pipelined: consume the exchange issued at step t−1 (a pure
             # add — zero delta at step 0), then issue this step's exchange;
             # its collectives have no consumer until step t+1's apply, so
@@ -371,7 +473,13 @@ def make_train_step(
             if overlap_on:
                 # a vacant slot neither issues nor consumes mixing deltas —
                 # zeroing every step also drops a leaver's stale in-flight
-                # delta the moment its slot vacates
+                # delta(s) the moment its slot vacates (at staleness K the
+                # worker-major ring masks through the same call)
+                if ring_on:
+                    gone = (mix_ages >= 0) & (member[:, None] <= 0)
+                    ring_dropped = ring_dropped + jnp.sum(
+                        gone.astype(jnp.float32))
+                    mix_ages = jnp.where(member[:, None] > 0, mix_ages, -1)
                 mix_pending = mask_worker_rows(mix_pending, member, n)
 
         def _fleet_mean(v):
@@ -425,8 +533,13 @@ def make_train_step(
                              if "alive_workers" in metrics
                              else jnp.asarray(np.float32(n))),
                 healed=heal_count,
-                # overlapped heal drops the healed rows' pending deltas
-                stale_dropped=(heal_count if overlap_on else None),
+                # overlapped heal drops the healed rows' pending deltas;
+                # the ring counts the actual (slot, worker) deltas zeroed
+                stale_dropped=(ring_dropped if ring_on
+                               else heal_count if overlap_on else None),
+                # the consumed-age histogram (DESIGN.md §20): which age
+                # each worker's consumed delta had this step
+                consumed_age=consumed_age,
                 # the health plane's attribution payload (DESIGN.md §17):
                 # who participated this step, and each row's deviation
                 # from consensus — fused adds like every other counter
@@ -440,6 +553,7 @@ def make_train_step(
                 opt_state=opt_state,
                 comm_carry=carry,
                 mix_pending=mix_pending if overlap_on else state.mix_pending,
+                mix_ages=mix_ages if ring_on else state.mix_ages,
                 telemetry=new_tel,
                 step=state.step + 1,
             ),

@@ -98,6 +98,36 @@ def _add_overlap_args(sp: argparse.ArgumentParser) -> None:
                     dest="wire_dtype",
                     help="model the narrowed gossip wire as bounded "
                          "per-step noise (bf16: eps = 2^-8)")
+    sp.add_argument("--staleness", type=int, default=1,
+                    help="bounded-staleness pipeline depth K (implies "
+                         "--overlap 1step when > 1): deltas issued at step "
+                         "t are consumed at t+K; the bound composes the "
+                         "delayed-recurrence inflation per eigenmode "
+                         "(train_tpu.py --staleness)")
+    sp.add_argument("--staleness-dist", default=None, dest="staleness_dist",
+                    help="consume-age distribution 'd:p,d:p' (e.g. "
+                         "'1:0.75,4:0.25' — a period-4 straggler whose "
+                         "deltas arrive three rounds late); overrides "
+                         "--staleness")
+    sp.add_argument("--local-steps", type=int, default=1, dest="local_steps",
+                    help="local SGD steps per gossip exchange: consensus "
+                         "contracts at rho^(1/L) per step (exact for the "
+                         "thinned stream); staleness delays count in "
+                         "exchange units ceil(K/L)")
+
+
+def _staleness_spec(args):
+    """Resolve (--staleness, --staleness-dist) into the predictor spec,
+    forcing the pipelined schedule on when a real delay is asked for."""
+    from matcha_tpu.plan import parse_staleness_spec
+
+    spec = (parse_staleness_spec(args.staleness_dist)
+            if args.staleness_dist else int(args.staleness))
+    delays = spec if isinstance(spec, dict) else {spec: 1.0}
+    overlap = args.overlap
+    if max(delays) > 1:
+        overlap = "1step"  # staleness > 1 IS the pipelined schedule
+    return spec, overlap
 
 
 def _topology_specs(args) -> list:
@@ -178,17 +208,20 @@ def cmd_rho(args) -> int:
                 np.asarray(cand["probs"]), cand["alpha"],
                 worker_alive=alive, link_up=1.0 - args.link_drop),
         }
-    if args.overlap != "off" or args.wire_dtype != "f32":
-        # pipelined-schedule view (DESIGN.md §11): the staleness-adjusted ρ
-        # for --overlap 1step (+ bf16 wire noise).  When the degraded-fleet
-        # flags are also given, the wire adjustment is applied ON TOP of
-        # the degraded mixing (masked Laplacians + effective probs) — the
-        # two views compose into the one ρ the faulty pipelined bf16 run
-        # actually has, instead of two numbers that are each missing half
-        # the story.
+    stale_spec, overlap = _staleness_spec(args)
+    delays = stale_spec if isinstance(stale_spec, dict) else {stale_spec: 1.0}
+    if overlap != "off" or args.wire_dtype != "f32" or args.local_steps > 1:
+        # pipelined-schedule view (DESIGN.md §11, §20): the staleness-
+        # adjusted ρ for --overlap 1step / --staleness K / --local-steps L
+        # (+ bf16 wire noise).  When the degraded-fleet flags are also
+        # given, the adjustments are applied ON TOP of the degraded mixing
+        # (masked Laplacians + effective probs) — the views compose into
+        # the one ρ the faulty async bf16 run actually has, instead of
+        # numbers that are each missing half the story.
         import numpy as np
 
-        from matcha_tpu.plan import degraded_solver_inputs
+        from matcha_tpu.plan import degraded_solver_inputs, \
+            stale_alpha_rescale
         from matcha_tpu.topology import matching_laplacians
 
         stale_Ls, stale_p = degraded_solver_inputs(
@@ -198,15 +231,28 @@ def cmd_rho(args) -> int:
             link_up=(1.0 - args.link_drop) if args.link_drop else None,
         ) if (alive_vals is not None or args.link_drop) else (
             matching_laplacians(decomposed, size), np.asarray(cand["probs"]))
+        # the damping scale the executor would apply (train/loop.py:
+        # _stale_scale) and the ρ at the damped α — reported next to the
+        # undamped bound so "what would this run actually contract at"
+        # and "what does raw staleness cost" are both answerable
+        scale, scaled_rho = stale_alpha_rescale(
+            stale_Ls, stale_p, cand["alpha"], staleness=stale_spec,
+            local_steps=args.local_steps)
         cand["stale"] = {
-            "overlap": args.overlap,
+            "overlap": overlap,
+            "staleness": (max(delays) if len(delays) == 1 else
+                          {str(d): p for d, p in delays.items()}),
+            "local_steps": int(args.local_steps),
             "wire_dtype": args.wire_dtype,
             "wire_eps": wire_quantization_eps(args.wire_dtype),
             "composed_with_degraded": bool(alive_vals is not None
                                            or args.link_drop),
             "rho": stale_contraction_rho(
                 stale_Ls, stale_p, cand["alpha"],
-                overlap=args.overlap, wire_dtype=args.wire_dtype),
+                overlap=overlap, wire_dtype=args.wire_dtype,
+                staleness=stale_spec, local_steps=args.local_steps),
+            "stale_alpha_scale": scale,
+            "rho_at_scaled_alpha": scaled_rho,
             # the rate claim is valid only above this RMS disagreement
             # (relative to parameter RMS): below it the bf16 wire's value
             # resolution is exhausted and contraction stalls — consensus
@@ -214,6 +260,28 @@ def cmd_rho(args) -> int:
             "disagreement_floor_rel": wire_disagreement_floor(
                 args.wire_dtype),
         }
+    if args.out:
+        # plan-format artifact (the async what-if as a committable,
+        # planlint-verifiable record): base candidate keys re-derive under
+        # PL001–PL008 exactly as a sweep's do; the stale view rides as an
+        # additive key.  Self-checked through planlint like sweep — a
+        # drifted solver/artifact must fail at write time, not review time.
+        from matcha_tpu.analysis import lint_plan_file, render_plan_text
+        from matcha_tpu.plan import PlanArtifact
+
+        artifact = PlanArtifact(chosen=cand, candidates=[cand],
+                                target_consensus=args.target,
+                                num_chips=args.chips,
+                                cost_model=CostModel().to_json())
+        save_plan(artifact, args.out)
+        plan_violations, _ = lint_plan_file(args.out)
+        if plan_violations:
+            print(render_plan_text(plan_violations, [args.out]),
+                  file=sys.stderr)
+            print(f"# wrote {args.out}, but it FAILS planlint — do not "
+                  f"commit", file=sys.stderr)
+            return 1
+        print(f"# wrote {args.out}", file=sys.stderr)
     print(json.dumps(cand, indent=1))
     return 0
 
@@ -390,13 +458,30 @@ def cmd_simulate(args) -> int:
     probs = solve_activation_probabilities(Ls, args.budget,
                                            iters=args.solver_iters)
     alpha, rho = solve_mixing_weight(Ls, probs)
+    stale_spec, overlap = _staleness_spec(args)
+    if isinstance(stale_spec, dict):
+        raise SystemExit("simulate runs the executor's point-delay ring; "
+                         "use --staleness K (distributions are a rho-only "
+                         "what-if)")
+    if stale_spec > 1:
+        # simulate what the executor would run: the damped α (the solved α
+        # oscillates under deep delay — plan.spectral.stale_alpha_rescale)
+        from matcha_tpu.plan import stale_alpha_rescale
+
+        scale, _ = stale_alpha_rescale(Ls, probs, alpha,
+                                       staleness=stale_spec,
+                                       local_steps=args.local_steps)
+        alpha = alpha * scale
     sim = simulate_consensus(decomposed, size, probs, alpha,
                              steps=args.mc_steps, trials=args.mc_trials,
                              seed=args.seed, laplacians=Ls,
-                             overlap=args.overlap, wire_dtype=args.wire_dtype)
+                             overlap=overlap, wire_dtype=args.wire_dtype,
+                             staleness=stale_spec,
+                             local_steps=args.local_steps)
     print(json.dumps({
         **norm, "budget": args.budget, "alpha": alpha,
-        "overlap": args.overlap, "wire_dtype": args.wire_dtype,
+        "overlap": overlap, "wire_dtype": args.wire_dtype,
+        "staleness": stale_spec, "local_steps": args.local_steps,
         "rho_bound": sim.rho_bound,
         "mc_empirical_rate": sim.empirical_rate(),
         "mean_decay_curve": [float(v) for v in sim.mean_decay_curve()],
@@ -440,6 +525,10 @@ def main(argv=None) -> int:
                          "view (matches schedule.with_link_failures / a "
                          "flaky_link fault event)")
     _add_overlap_args(sp)
+    sp.add_argument("--out", default=None,
+                    help="write the candidate (incl. the staleness view) "
+                         "as a plan-format artifact, self-checked through "
+                         "planlint like sweep's output")
     sp.set_defaults(fn=cmd_rho)
 
     sp = sub.add_parser("simulate", help="Monte-Carlo consensus trajectory")

@@ -116,6 +116,32 @@ def parse_args(argv=None) -> TrainConfig:
                         "next fwd/bwd; one-step-stale semantics — see "
                         "plan_tpu.py rho --overlap for the predicted "
                         "contraction effect")
+    p.add_argument("--staleness", type=int, default=1,
+                   help="bounded-staleness pipeline depth K (needs "
+                        "--overlap 1step): in-flight mixing deltas age "
+                        "through a static [N, K, D] pending ring — issued "
+                        "at step t, consumed at t+K — so fast workers run "
+                        "K steps ahead of a straggler's delta.  K=1 is the "
+                        "committed one-step pipeline bitwise; K>=2 damps "
+                        "the executed mixing weight for the delayed "
+                        "dynamics (plan_tpu.py rho --staleness K predicts "
+                        "the composed contraction)")
+    p.add_argument("--local-steps", type=int, default=1, dest="local_steps",
+                   help="local SGD steps per gossip exchange: the flag "
+                        "stream is statically thinned to every L-th row, "
+                        "so gossip cost is paid 1/L as often and consensus "
+                        "contracts at rho^(1/L) per step; composes with "
+                        "--staleness (delays count in exchange units "
+                        "ceil(K/L))")
+    p.add_argument("--gossip-measured-source", default=None,
+                   dest="gossip_measured_source",
+                   help="artifact to extract the auto gate's measured-vs-"
+                        "ceiling ratio from (instead of typing "
+                        "--gossip-measured-ratio): a run journal with "
+                        "roofline records (obs_tpu.py roofline --journal), "
+                        "a bench_live_r*.json capture, or a raw roofline-"
+                        "report JSON; provenance journaled in the "
+                        "`backend` event")
     p.add_argument("--wire-dtype", default="f32", choices=["f32", "bf16"],
                    dest="wire_dtype",
                    help="dtype of the exchanged tensors at the gossip "
@@ -266,7 +292,9 @@ def parse_args(argv=None) -> TrainConfig:
         gossip_backend=args.backend, gossip_block_d=args.block_d,
         gossip_w_window=args.w_window,
         gossip_measured_vs_ceiling=args.gossip_measured_vs_ceiling,
-        overlap=args.overlap,
+        gossip_measured_source=args.gossip_measured_source,
+        overlap=args.overlap, staleness=args.staleness,
+        local_steps=args.local_steps,
         wire_dtype=args.wire_dtype, save=args.save, savePath=args.savePath,
         checkpoint_every=args.checkpoint_every, resume=args.resume,
         fault_plan=args.fault_plan, max_recoveries=args.max_recoveries,
