@@ -25,6 +25,14 @@ timeout -k 30 240 python benchmarks/tpu_gate.py --out benchmarks/tpu_gate.json; 
 #     the stamp carries clean=true/false either way.
 timeout -k 10 120 python lint_tpu.py --format json > benchmarks/lint_stamp_r6.json \
     || echo "lint stamp: violations recorded in benchmarks/lint_stamp_r6.json"
+#     ... and the graftcontract verdict next to it (ISSUE 15): the
+#     sync-budget prover against the committed sync_budget.json manifest,
+#     journal-schema call sites, checkpoint-evolution coverage — a bench
+#     number from a tree that sneaks a per-step host sync past the budget
+#     is measuring a different program than the one the docs describe.
+timeout -k 10 120 python lint_tpu.py --rules GL201,GL202,GL203 --format json \
+    > benchmarks/contracts_stamp_r6.json \
+    || echo "graftcontract: violations recorded in benchmarks/contracts_stamp_r6.json"
 #     ... and that the committed plan artifacts still verify numerically
 #     (PL001–PL008): a bench driven by a stale/tampered plan JSON measures
 #     a schedule the solver never scored.

@@ -4,8 +4,14 @@
 # Six stages, each loud on failure; the gate fails if any stage fails:
 #
 #   1. graftlint     GL001–GL006 (syntactic) + GL101–GL104 (SPMD dataflow)
-#                    over the shipped surface (incl. matcha_tpu/obs and
-#                    obs_tpu.py), empty baseline
+#                    + GL201–GL203 (graftcontract) over the shipped
+#                    surface (incl. matcha_tpu/obs and obs_tpu.py), empty
+#                    baseline
+#   1.5 graftcontract  GL201–GL203 in isolation: sync-budget prover
+#                    against the committed sync_budget.json manifest,
+#                    journal-schema call sites, checkpoint-evolution
+#                    coverage — its own loud stage so a contract break is
+#                    named as one, plus the contracts pytest lane
 #   2. lint-plan     PL001–PL008 numeric verification of every committed
 #                    schedule/plan artifact under benchmarks/
 #   3. analysis lane the same engines + the dynamic retrace sanitizer +
@@ -68,9 +74,17 @@ fi
 
 rc=0
 
-echo "== graftlint (GL0xx + GL1xx) =="
+echo "== graftlint (GL0xx + GL1xx + GL2xx) =="
 # ${arr[@]+...} expansion: empty-array-safe under `set -u` on bash < 4.4
 python lint_tpu.py ${CHANGED_ARGS[@]+"${CHANGED_ARGS[@]}"} || rc=1
+
+echo "== graftcontract (GL201-GL203 + sync_budget.json manifest) =="
+python lint_tpu.py --rules GL201,GL202,GL203 \
+    ${CHANGED_ARGS[@]+"${CHANGED_ARGS[@]}"} || rc=1
+
+echo "== contracts pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m contracts -p no:cacheprovider || rc=1
 
 echo "== planlint (lint-plan over benchmarks/) =="
 python lint_tpu.py lint-plan || rc=1

@@ -5,12 +5,15 @@ The rules encode the invariants the MATCHA-class guarantees hang on — the
 syntactic GL0xx family (``matcha_tpu/analysis/rules.py``: where-not-multiply
 NaN masking, host purity of compiled code, the shared collective axis
 constant, the single wire_dtype seam, the two-phase communicator contract,
-loud failure paths) and the interprocedural GL1xx SPMD-safety family
+loud failure paths), the interprocedural GL1xx SPMD-safety family
 (``spmd_rules.py``: verified ppermute permutation tables, no collectives
 under worker-divergent control flow, quantize-exactly-once wire lattice,
-static retrace prediction).  ``tests/test_analysis.py`` and
-``tests/test_dataflow.py`` run the same engine in tier-1; this CLI is the
-interactive/CI surface.
+static retrace prediction), and the GL2xx graftcontract family
+(``contracts.py``: the sync-budget prover against the committed
+``sync_budget.json`` manifest, the journal-schema call-site verifier, and
+checkpoint-evolution coverage).  ``tests/test_analysis.py``,
+``tests/test_dataflow.py`` and ``tests/test_contracts.py`` run the same
+engine in tier-1; this CLI is the interactive/CI surface.
 
 Examples
 --------
@@ -36,6 +39,10 @@ Grandfather the current violations (new ones still fail)::
 
     python lint_tpu.py --write-baseline
 
+Regenerate the GL201 sync-budget manifest from the annotated tree::
+
+    python lint_tpu.py --write-sync-budget
+
 Exit code 0 = clean (modulo baseline), 1 = violations, 2 = usage error.
 """
 
@@ -49,6 +56,8 @@ import sys
 
 from matcha_tpu.analysis import (
     PLAN_CHECKS,
+    SYNC_BUDGET_PATH,
+    collect_sources,
     lint_paths,
     lint_plan_paths,
     load_baseline,
@@ -57,6 +66,7 @@ from matcha_tpu.analysis import (
     render_text,
     rules_by_id,
     write_baseline,
+    write_sync_budget,
 )
 
 # the shipped lint surface: the package and every executable entry point.
@@ -158,6 +168,11 @@ def main(argv=None) -> int:
                    help="ignore the baseline: report every violation")
     p.add_argument("--write-baseline", action="store_true",
                    help="record current violations into --baseline and exit 0")
+    p.add_argument("--write-sync-budget", action="store_true",
+                   help="regenerate sync_budget.json (GL201) from the "
+                        "annotated tree; refuses while any reachable sync "
+                        "lacks its `# graftcontract: sync — reason` "
+                        "annotation")
     p.add_argument("--list-rules", action="store_true",
                    help="print every rule id, title, and invariant")
     p.add_argument("--changed", default=None, metavar="REF",
@@ -190,10 +205,11 @@ def main(argv=None) -> int:
                   "exclusive (the flag computes its own path set)",
                   file=sys.stderr)
             return 2
-        if args.write_baseline:
-            print("lint_tpu: refusing --changed with --write-baseline — a "
-                  "baseline written from a partial path set drops every "
-                  "unchanged file's grandfathered entries", file=sys.stderr)
+        if args.write_baseline or args.write_sync_budget:
+            print("lint_tpu: refusing --changed with --write-baseline/"
+                  "--write-sync-budget — a manifest written from a partial "
+                  "path set drops every unchanged file's entries",
+                  file=sys.stderr)
             return 2
         touched = changed_paths(args.changed)
         if touched is None:
@@ -205,6 +221,27 @@ def main(argv=None) -> int:
                   f"{args.changed}")
             return 0
         paths = touched
+
+    if args.write_sync_budget:
+        # the manifest is regenerated from the FULL default surface unless
+        # explicit paths narrow it deliberately — same guard philosophy as
+        # --write-baseline above
+        try:
+            sources = collect_sources(paths, repo_root=REPO_ROOT)
+        except (FileNotFoundError, SyntaxError) as e:
+            print(f"lint_tpu: {e}", file=sys.stderr)
+            return 2
+        count, unmarked = write_sync_budget(sources)
+        if unmarked:
+            for line in unmarked:
+                print(f"lint_tpu: {line}", file=sys.stderr)
+            print("lint_tpu: refusing to write sync_budget.json — annotate "
+                  "the sites above first (the reason is the manifest's "
+                  "value)", file=sys.stderr)
+            return 1
+        print(f"lint_tpu: wrote {count} sync-budget entr(ies) to "
+              f"{SYNC_BUDGET_PATH.name}")
+        return 0
 
     baseline = set() if (args.no_baseline or args.write_baseline) \
         else load_baseline(args.baseline)

@@ -8,6 +8,9 @@ Machine-checks the invariants earlier PRs established only as review lore:
 * ``dataflow``   — the interprocedural layer: module call graphs, function
   summaries, constant folding, ``# graftverify: bind`` hints
 * ``spmd_rules`` — GL101–GL104, the SPMD-safety family riding ``dataflow``
+* ``contracts``  — GL201–GL203, the graftcontract family: the sync-budget
+  prover (committed ``sync_budget.json`` manifest), the journal-schema
+  call-site verifier, checkpoint-evolution coverage
 * ``planlint``   — PL001–PL008, numeric verification of committed plan
   artifacts (``python lint_tpu.py lint-plan``)
 * ``sanitizer``  — the dynamic retrace (recompilation) detector
@@ -19,6 +22,13 @@ linter must run (and fail fast) even on a host whose accelerator backend
 is wedged.
 """
 
+from .contracts import (
+    CONTRACT_RULES,
+    SYNC_BUDGET_PATH,
+    collect_sync_sites,
+    load_sync_budget,
+    write_sync_budget,
+)
 from .engine import (
     LintSource,
     Rule,
@@ -46,15 +56,18 @@ from .spmd_rules import SPMD_RULES
 
 __all__ = [
     "ALL_RULES",
+    "CONTRACT_RULES",
     "CORE_RULES",
     "LintSource",
     "PLAN_CHECKS",
     "Rule",
     "SPMD_RULES",
+    "SYNC_BUDGET_PATH",
     "TraceCount",
     "Violation",
     "check_single_trace",
     "collect_sources",
+    "collect_sync_sites",
     "discover_plan_files",
     "lint_link_costs_data",
     "lint_paths",
@@ -63,10 +76,12 @@ __all__ = [
     "lint_plan_paths",
     "lint_source",
     "load_baseline",
+    "load_sync_budget",
     "render_json",
     "render_plan_text",
     "render_text",
     "retrace_guard",
     "rules_by_id",
     "write_baseline",
+    "write_sync_budget",
 ]

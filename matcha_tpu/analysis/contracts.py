@@ -1,0 +1,818 @@
+"""graftcontract — GL201–GL203, the runtime-contract proving family.
+
+Three contracts the scaling story hangs on were, until this module,
+enforced only dynamically (a flush-count test, a reference journal, a
+broken-resume report three PRs late).  Each rule turns one of them into a
+lint-time proof over the shared :mod:`dataflow` layer:
+
+========  ==================================================================
+GL201     sync-budget prover: every device-sync-inducing site reachable
+          from a ``# graftcontract: root`` train-loop root is classified by
+          loop scope (run / epoch / batch / step) via loop-nesting analysis
+          over the call graph, must carry a ``# graftcontract: sync — why``
+          annotation, and must be covered by the committed
+          ``sync_budget.json`` manifest — a new per-step or per-batch sync
+          fails CI before it ever reaches a 256-worker mesh ("From promise
+          to practice", PAPERS.md: stray host synchronization is the
+          dominant killer of comm/compute overlap)
+GL202     journal-schema call-site verifier: every ``make_event`` /
+          ``log_event`` / ``log_fault`` / ``append_journal_record`` site
+          with a literal kind is checked against ``obs/journal.py``'s
+          pinned registry (kind registered, literal field sets ⊇
+          REQUIRED_FIELDS), and the registry itself is proven additive:
+          kinds beyond the frozen v1 vocabulary need a KIND_MIN_VERSION
+          entry, min versions fit inside SCHEMA_VERSION, and the version
+          set is gapless — the evolution discipline previously re-pinned by
+          hand each PR
+GL203     checkpoint-evolution coverage: every defaulted ``TrainState``
+          field must be reconciled by the restore retry ladder in
+          ``train/checkpoint.py`` (a ladder generation dropping it, or the
+          telemetry-style strip), the ladder must not name dead fields, and
+          save/restore strip sets must agree — adding a state field without
+          a reconciliation rule is a lint error, not a broken-resume report
+          (the PR-6/9/14 bug class)
+========  ==================================================================
+
+Annotation grammar (same standalone-or-trailing attachment as graftlint
+suppressions and graftverify bind hints)::
+
+    jax.block_until_ready(state.params)  # graftcontract: sync — the one per-epoch barrier
+
+    # graftcontract: root
+    def train(config):
+        ...
+
+Budget-manifest workflow: ``python lint_tpu.py --write-sync-budget``
+regenerates ``sync_budget.json`` from the annotated tree (it refuses while
+any reachable sync is unannotated).  Unlike ``graftlint_baseline.json``
+the manifest ships *full*: every allowed sync, with its scope and the
+reason string harvested from its annotation.  GL201 matches sites to
+entries by (path, root, scope, call) counts — line numbers are recorded
+for humans but not matched, so ordinary edits don't invalidate the budget;
+adding, removing, or re-scoping a sync does.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .dataflow import NotFoldable, const_eval, dotted_name, module_graph
+from .engine import LintSource, Rule, Violation, attach_to_next_code_line
+
+__all__ = [
+    "CONTRACT_RULES",
+    "SYNC_BUDGET_PATH",
+    "collect_sync_sites",
+    "load_sync_budget",
+    "write_sync_budget",
+]
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+SYNC_BUDGET_PATH = REPO_ROOT / "sync_budget.json"
+JOURNAL_PATH = REPO_ROOT / "matcha_tpu" / "obs" / "journal.py"
+
+_ROOT_RE = re.compile(r"#\s*graftcontract:\s*root\b")
+_SYNC_RE = re.compile(r"#\s*graftcontract:\s*sync\s*(?:—|–|-{1,2})\s*(.+)")
+
+
+def parse_contract_markers(lines: Sequence[str]
+                           ) -> Tuple[Set[int], Dict[int, str]]:
+    """(root-marked lines, sync-marked line -> reason) — both attached via
+    the shared standalone-or-trailing comment grammar.  A standalone sync
+    marker's reason continues across the comment lines under it (the
+    manifest carries the whole annotation, not its first line)."""
+    roots: Set[int] = set()
+    syncs: Dict[int, str] = {}
+    for lineno, line in enumerate(lines, 1):
+        if _ROOT_RE.search(line):
+            roots.add(attach_to_next_code_line(lines, lineno))
+        m = _SYNC_RE.search(line)
+        if m and m.group(1).strip():
+            reason = [m.group(1).strip()]
+            if line.lstrip().startswith("#"):  # standalone: continuation
+                for nxt in lines[lineno:]:
+                    stripped = nxt.strip()
+                    if not stripped.startswith("#") \
+                            or "graftcontract:" in stripped \
+                            or "graftlint:" in stripped:
+                        break
+                    reason.append(stripped.lstrip("#").strip())
+            syncs[attach_to_next_code_line(lines, lineno)] = \
+                " ".join(r for r in reason if r)
+    return roots, syncs
+
+
+# =========================================================================
+# GL201 — sync-budget prover
+# =========================================================================
+
+#: numpy calls that materialize their argument on the host — a device
+#: value reaching one of these is a device→host sync (a host value is the
+#: annotation's claim to make)
+_SYNC_NP = {"asarray", "array", "mean", "sum"}
+#: named calls that force a sync by contract: the explicit barrier/readback
+#: primitives plus the repo's own boundary flushes (the accumulator read
+#: and the checkpoint write both materialize device state)
+_SYNC_CALLS = {"block_until_ready", "device_get", "telemetry_flush",
+               "save_checkpoint"}
+#: attribute-call forms -> manifest label: `.item()` readbacks, the
+#: recorder's no-arg `.save()` flush, the health plane's `.beat(...)` emit;
+#: `block_until_ready` keeps the named-call label whatever the receiver
+#: shape, so refactoring `x.block_until_ready()` to a non-Name-rooted
+#: receiver cannot spuriously break the budget
+_SYNC_ATTRS = {"item": ".item()", "block_until_ready": "block_until_ready",
+               "save": ".save()", "beat": ".beat()"}
+
+#: loop-nesting depth -> scope label; sites inside a compiled (jit /
+#: shard_map) function are "step" regardless of python depth — they run
+#: once per scanned step
+_SCOPE_BY_DEPTH = {0: "run", 1: "epoch", 2: "batch"}
+#: scopes the budget covers; "run" (once per run, outside every loop)
+#: cannot hurt scaling and is exempt
+ENFORCED_SCOPES = ("epoch", "batch", "step")
+
+
+def _classify_sync(call: ast.Call) -> Optional[str]:
+    """The sync label of a call, or None.  Labels are the manifest's
+    ``call`` vocabulary (``np.asarray``, ``.item()``, ``telemetry_flush``,
+    …)."""
+    fn = dotted_name(call.func)
+    if fn is not None:
+        leaf = fn.split(".")[-1]
+        if leaf in _SYNC_NP and (fn.startswith("np.")
+                                 or fn.startswith("numpy.")):
+            return f"np.{leaf}"
+        if leaf in _SYNC_CALLS:
+            return leaf
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        label = _SYNC_ATTRS.get(attr)
+        if label is None:
+            return None
+        if attr == "item" and call.args:
+            return None  # .item(i) indexing form — not the scalar readback
+        if attr == "save" and (call.args or call.keywords):
+            return None  # only the recorder's no-arg flush counts
+        return label
+    return None
+
+
+def _scope(depth: int, in_compiled: bool) -> str:
+    return "step" if in_compiled else _SCOPE_BY_DEPTH.get(depth, "step")
+
+
+def collect_sync_sites(source: LintSource
+                       ) -> List[Tuple[str, str, str, int]]:
+    """Every sync-inducing site reachable from a root-marked function,
+    as ``(root, scope, call, line)`` — loop-nesting depth tracked through
+    the module call graph (local calls, nested defs, aliases).  Re-visits
+    of one call node collapse; distinct sync calls sharing a line each
+    keep their own entry.  Only :data:`ENFORCED_SCOPES` sites are
+    returned."""
+    root_lines, _ = parse_contract_markers(source.lines)
+    if not root_lines:
+        return []
+    graph = module_graph(source)
+    roots = [(name, node) for name, nodes in graph.functions.items()
+             for node in nodes
+             if getattr(node, "lineno", None) in root_lines]
+    compiled_ids = {id(fn) for _, fn in graph.compiled_functions_cached()}
+    # site key -> distinct Call node ids: a re-visit of the same node (the
+    # same helper reached twice at one depth) collapses, but two separate
+    # sync calls sharing a line each keep their own budget slot
+    sites: Dict[Tuple[str, str, str, int], Set[int]] = {}
+
+    for root_name, root_node in roots:
+        visited: Set[Tuple[int, int, bool]] = set()
+
+        def walk_calls(expr: ast.AST):
+            """ast.walk minus Lambda bodies: a lambda merely *defined* in
+            an expression executes only when called — the same rule
+            scan_body applies to def/class.  A later call by name still
+            descends (collect_functions registers `cb = lambda ...`)."""
+            stack = [expr]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ast.Lambda):
+                    continue
+                yield n
+                stack.extend(ast.iter_child_nodes(n))
+
+        def scan_expr(expr: ast.AST, depth: int, ic: bool) -> None:
+            for n in walk_calls(expr):
+                if not isinstance(n, ast.Call):
+                    continue
+                label = _classify_sync(n)
+                if label is not None:
+                    sc = _scope(depth, ic)
+                    if sc in ENFORCED_SCOPES:
+                        sites.setdefault(
+                            (root_name, sc, label, n.lineno),
+                            set()).add(id(n))
+                fn = dotted_name(n.func)
+                if fn is not None:
+                    for defn in graph.resolve(fn):
+                        descend(defn, depth, ic)
+
+        def _is_dict_iteration(it: ast.AST) -> bool:
+            """`for k, v in d.items()` (/keys/values): bounded host dict
+            iteration, not a training-granularity loop — without this, a
+            metrics-dict loop inside a per-batch helper would classify its
+            reads as phantom per-'step' syncs and commit budget slots that
+            could mask a real per-step regression."""
+            return (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Attribute)
+                    and it.func.attr in ("items", "keys", "values"))
+
+        def scan_body(stmts: List[ast.stmt], depth: int, ic: bool) -> None:
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue  # definitions execute only when called
+                if isinstance(st, ast.For):
+                    scan_expr(st.iter, depth, ic)
+                    bump = 0 if _is_dict_iteration(st.iter) else 1
+                    scan_body(st.body, depth + bump, ic)
+                    scan_body(st.orelse, depth, ic)
+                elif isinstance(st, ast.While):
+                    scan_expr(st.test, depth, ic)
+                    scan_body(st.body, depth + 1, ic)
+                    scan_body(st.orelse, depth, ic)
+                elif isinstance(st, ast.If):
+                    scan_expr(st.test, depth, ic)
+                    scan_body(st.body, depth, ic)
+                    scan_body(st.orelse, depth, ic)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    for item in st.items:
+                        scan_expr(item.context_expr, depth, ic)
+                    scan_body(st.body, depth, ic)
+                elif isinstance(st, ast.Try):
+                    scan_body(st.body, depth, ic)
+                    for h in st.handlers:
+                        scan_body(h.body, depth, ic)
+                    scan_body(st.orelse, depth, ic)
+                    scan_body(st.finalbody, depth, ic)
+                else:
+                    scan_expr(st, depth, ic)
+
+        def descend(defn: ast.AST, depth: int, ic: bool) -> None:
+            key = (id(defn), min(depth, 3), ic)
+            if key in visited:
+                return
+            visited.add(key)
+            ic = ic or id(defn) in compiled_ids
+            body = getattr(defn, "body", None)
+            if isinstance(body, list):
+                scan_body(body, depth, ic)
+            elif body is not None:  # lambda
+                scan_expr(body, depth, ic)
+
+        descend(root_node, 0, False)
+    return sorted(key for key, node_ids in sites.items()
+                  for _ in range(len(node_ids)))
+
+
+def load_sync_budget(path: str | pathlib.Path = SYNC_BUDGET_PATH
+                     ) -> List[dict]:
+    p = pathlib.Path(path)
+    if not p.exists():
+        return []
+    return list(json.loads(p.read_text()).get("allowed", []))
+
+
+def write_sync_budget(sources: Sequence[LintSource],
+                      path: str | pathlib.Path = SYNC_BUDGET_PATH,
+                      ) -> Tuple[int, List[str]]:
+    """Regenerate the manifest from the annotated tree.  Returns
+    ``(entries written, unannotated-site descriptions)`` — nothing is
+    written while any reachable sync lacks its reason annotation (the
+    reason IS the manifest's value; an empty one would launder an unknown
+    sync into an allowed one)."""
+    entries: List[dict] = []
+    unmarked: List[str] = []
+    for src in sources:
+        sites = collect_sync_sites(src)
+        if not sites:
+            continue
+        _, sync_markers = parse_contract_markers(src.lines)
+        for root, scope, call, line in sites:
+            reason = sync_markers.get(line)
+            if reason is None:
+                unmarked.append(
+                    f"{src.path}:{line}: `{call}` at {scope} scope "
+                    f"(root `{root}`) has no `# graftcontract: sync — "
+                    f"reason` annotation")
+            else:
+                entries.append({
+                    "path": src.path, "root": root, "scope": scope,
+                    "call": call, "line": line, "reason": reason,
+                })
+    if unmarked:
+        return 0, unmarked
+    payload = {
+        "comment": "graftcontract GL201 sync-budget manifest — every "
+                   "device-sync-inducing site reachable from a train-loop "
+                   "root, with loop scope and the annotated reason; ships "
+                   "FULL (unlike the graftlint baseline) and is matched by "
+                   "(path, root, scope, call) counts.  Regenerate with "
+                   "`python lint_tpu.py --write-sync-budget` (docs/"
+                   "DESIGN.md §21).",
+        "allowed": sorted(
+            entries, key=lambda e: (e["path"], e["root"], e["scope"],
+                                    e["call"], e["line"])),
+    }
+    pathlib.Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+    return len(entries), []
+
+
+class GL201SyncBudget(Rule):
+    id = "GL201"
+    title = "host↔device sync outside the committed sync budget"
+    invariant = (
+        "The train loop performs exactly the syncs the committed "
+        "sync_budget.json allows — the PR-7/PR-10 'zero new device syncs' "
+        "pin, proven at lint time instead of discovered at epoch 1 on a "
+        "256-worker mesh.  Every sync-inducing call (block_until_ready, "
+        ".item(), device_get, np.asarray/np.array/np.mean/np.sum "
+        "materialization, telemetry/recorder/heartbeat/checkpoint flushes) "
+        "reachable from a `# graftcontract: root` function is classified "
+        "by loop scope (epoch / batch / step) via loop-nesting analysis "
+        "over the call graph; each must carry a `# graftcontract: sync — "
+        "reason` annotation and a matching manifest entry.  A new per-step "
+        "or per-batch sync therefore fails CI with its site and scope "
+        "named.  Once-per-run sites (outside every loop) are exempt; "
+        "genuinely host-only materializations annotate the reason — the "
+        "annotation is the audit artifact.  Bare float()/int() readbacks "
+        "are deliberately OUTSIDE the vocabulary (host-float conversions "
+        "are everywhere; flagging them would drown the rule): the repo "
+        "convention is to route device-scalar reads through np.asarray "
+        "(e.g. int(np.asarray(state.step))), which IS in the vocabulary — "
+        "GL002 still catches float()/int() inside compiled code.  Like "
+        "every ModuleGraph rule the reach is per translation unit "
+        "(DESIGN.md §13): a sync hidden in an imported helper is visible "
+        "only where that helper's module declares its own root."
+    )
+
+    def __init__(self, manifest=None):
+        # dict (tests), path, or None -> the committed SYNC_BUDGET_PATH
+        self._manifest = manifest
+        self._entries_cache: Optional[List[dict]] = None
+
+    def _entries(self) -> List[dict]:
+        if self._entries_cache is None:
+            if isinstance(self._manifest, dict):
+                self._entries_cache = list(self._manifest.get("allowed", []))
+            else:
+                self._entries_cache = load_sync_budget(
+                    self._manifest or SYNC_BUDGET_PATH)
+        return self._entries_cache
+
+    def check(self, source: LintSource) -> List[Violation]:
+        root_lines, sync_markers = parse_contract_markers(source.lines)
+        manifest = [e for e in self._entries()
+                    if e.get("path") == source.path]
+        out: List[Violation] = []
+        if not root_lines:
+            if manifest:
+                out.append(Violation(
+                    rule=self.id, path=source.path, line=1, col=0,
+                    message=f"sync_budget.json carries {len(manifest)} "
+                            f"entr(ies) for this file but it declares no "
+                            f"`# graftcontract: root` — stale manifest; "
+                            f"regenerate with --write-sync-budget"))
+            return out
+        sites = collect_sync_sites(source)
+        allowed: Dict[Tuple[str, str, str], int] = {}
+        for e in manifest:
+            key = (e.get("root", "?"), e.get("scope", "?"),
+                   e.get("call", "?"))
+            allowed[key] = allowed.get(key, 0) + 1
+        found: Dict[Tuple[str, str, str], int] = {}
+        for root, scope, call, line in sites:
+            anchor = ast.Module(body=[], type_ignores=[])
+            anchor.lineno, anchor.col_offset = line, 0
+            reason = sync_markers.get(line)
+            key = (root, scope, call)
+            # an unannotated site still consumes its budget slot — it WAS
+            # found, so the stale-manifest sweep below must not add a
+            # second, misleading "regenerate" diagnostic for it
+            # (--write-sync-budget refuses while it is unannotated)
+            found[key] = found.get(key, 0) + 1
+            if reason is None:
+                out.append(self.hit(
+                    source, anchor,
+                    f"sync-inducing `{call}` at **{scope}** scope, "
+                    f"reachable from root `{root}` — annotate with "
+                    f"`# graftcontract: sync — reason` and record it in "
+                    f"sync_budget.json (--write-sync-budget), hoist it to "
+                    f"the epoch boundary, or suppress with a reason"))
+                continue
+            if found[key] > allowed.get(key, 0):
+                out.append(self.hit(
+                    source, anchor,
+                    f"`{call}` at **{scope}** scope from root `{root}` "
+                    f"exceeds the committed sync budget "
+                    f"({allowed.get(key, 0)} allowed in sync_budget.json) "
+                    f"— a new per-{scope} sync; remove it or re-run "
+                    f"--write-sync-budget and justify the entry in review"))
+        for key, n in sorted(allowed.items()):
+            if found.get(key, 0) < n:
+                root, scope, call = key
+                out.append(Violation(
+                    rule=self.id, path=source.path,
+                    line=min(root_lines), col=0,
+                    message=f"sync_budget.json allows {n} `{call}` "
+                            f"sync(s) at {scope} scope for root `{root}` "
+                            f"but only {found.get(key, 0)} found — stale "
+                            f"manifest; regenerate with "
+                            f"--write-sync-budget"))
+        return out
+
+
+# =========================================================================
+# GL202 — journal-schema call-site verifier
+# =========================================================================
+
+#: the frozen v1 vocabulary (base kinds + the historical fault-ledger
+#: kinds).  Pinned HERE, once: any EVENT_KINDS member beyond this set must
+#: declare a KIND_MIN_VERSION entry — a kind quietly added to the v1 base
+#: would validate old journals claiming a version that predates it (the
+#: lying-envelope class validate_event exists to catch).
+_V1_KINDS = frozenset({
+    "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
+    "retrace", "bench",
+    "plan", "healed", "rollback", "alpha_rederived", "emergency_checkpoint",
+})
+
+#: emitter leaf name -> (index of the kind argument, fault-ledger only)
+_EMITTERS: Dict[str, Tuple[int, bool]] = {
+    "make_event": (0, False),
+    "log_event": (0, False),
+    "log_fault": (0, True),
+    "append_journal_record": (1, False),
+}
+
+_REGISTRY_FOLD_ERRORS = (NotFoldable, TypeError, ValueError, KeyError,
+                         AttributeError, IndexError, ZeroDivisionError)
+
+
+def extract_registry(tree: ast.AST
+                     ) -> Optional[Tuple[Dict[str, object],
+                                         Dict[str, ast.AST]]]:
+    """Fold the journal schema registry out of a module's AST — no import,
+    no exec: module-level assignments are const-evaluated in order under
+    the accumulating environment (SCHEMA_VERSION, the *_KINDS frozensets,
+    the KIND_MIN_VERSION dict-merge, REQUIRED_FIELDS).  Returns ``(env,
+    anchor nodes)`` when the module defines ``EVENT_KINDS``, else None."""
+    if not isinstance(tree, ast.Module):
+        return None
+    env: Dict[str, object] = {}
+    anchors: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            name, value = node.target.id, node.value
+        else:
+            continue
+        try:
+            env[name] = const_eval(value, env)
+            anchors[name] = node
+        except _REGISTRY_FOLD_ERRORS:
+            continue
+    if not isinstance(env.get("EVENT_KINDS"), (set, frozenset)):
+        return None
+    return env, anchors
+
+
+class GL202JournalSchema(Rule):
+    id = "GL202"
+    title = "journal event site or schema registry breaks additive evolution"
+    invariant = (
+        "events.jsonl evolves strictly additively: every make_event / "
+        "log_event / log_fault / append_journal_record site with a literal "
+        "kind must name a kind registered in obs/journal.py's EVENT_KINDS "
+        "(fault sites: FAULT_KINDS), and its literal field set must cover "
+        "REQUIRED_FIELDS[kind] (a `**`-splat leaves the set open — the "
+        "runtime validate_event still guards it).  The registry itself is "
+        "proven additive against the frozen v1 vocabulary: a kind beyond "
+        "it needs a KIND_MIN_VERSION entry, every min version fits inside "
+        "SCHEMA_VERSION, ACCEPTED_VERSIONS is the gapless 1..SCHEMA_VERSION "
+        "range, and the newest version actually introduces a kind — the "
+        "v1→v5 convention previously re-pinned by hand each PR, now checked "
+        "on every lint run."
+    )
+
+    def __init__(self, registry_path=None):
+        self._registry_path = pathlib.Path(registry_path or JOURNAL_PATH)
+        self._default_registry: Optional[Dict[str, object]] = None
+        self._default_loaded = False
+
+    def _registry(self) -> Optional[Dict[str, object]]:
+        if not self._default_loaded:
+            self._default_loaded = True
+            if self._registry_path.exists():
+                extracted = extract_registry(
+                    ast.parse(self._registry_path.read_text()))
+                if extracted is not None:
+                    self._default_registry = extracted[0]
+        return self._default_registry
+
+    def check(self, source: LintSource) -> List[Violation]:
+        out: List[Violation] = []
+        local = extract_registry(source.tree)
+        if local is not None:
+            reg, anchors = local
+            self._check_registry(source, reg, anchors, out)
+            registry = reg
+        else:
+            registry = self._registry()
+        if registry is not None:
+            self._check_sites(source, registry, out)
+        return out
+
+    def _check_registry(self, source: LintSource, reg: Dict[str, object],
+                        anchors: Dict[str, ast.AST],
+                        out: List[Violation]) -> None:
+        def anchor(name: str) -> ast.AST:
+            return anchors.get(name, anchors["EVENT_KINDS"])
+
+        version = reg.get("SCHEMA_VERSION")
+        kinds = reg.get("EVENT_KINDS", frozenset())
+        min_version = reg.get("KIND_MIN_VERSION", {})
+        required = reg.get("REQUIRED_FIELDS", {})
+        accepted = reg.get("ACCEPTED_VERSIONS")
+        if not isinstance(version, int) or version < 1:
+            out.append(self.hit(
+                source, anchor("SCHEMA_VERSION"),
+                f"SCHEMA_VERSION must be a positive int, got {version!r}"))
+            return
+        if isinstance(accepted, (set, frozenset)) \
+                and accepted != set(range(1, version + 1)):
+            out.append(self.hit(
+                source, anchor("ACCEPTED_VERSIONS"),
+                f"ACCEPTED_VERSIONS {sorted(accepted)} is not the gapless "
+                f"1..{version} range — old journals must stay first-class "
+                f"sources (additive evolution)"))
+        if isinstance(min_version, dict):
+            for kind in sorted(kinds - _V1_KINDS):
+                if kind not in min_version:
+                    out.append(self.hit(
+                        source, anchor("EVENT_KINDS"),
+                        f"kind {kind!r} joined EVENT_KINDS beyond the "
+                        f"frozen v1 vocabulary without a KIND_MIN_VERSION "
+                        f"entry — without it a v1 envelope claiming the "
+                        f"new kind validates (the lying-envelope class)"))
+            for kind, v in sorted(min_version.items()):
+                if kind not in kinds:
+                    out.append(self.hit(
+                        source, anchor("KIND_MIN_VERSION"),
+                        f"KIND_MIN_VERSION names {kind!r}, which is not in "
+                        f"EVENT_KINDS — stale entry"))
+                if not isinstance(v, int) or not 2 <= v <= version:
+                    out.append(self.hit(
+                        source, anchor("KIND_MIN_VERSION"),
+                        f"kind {kind!r} claims min version {v!r} outside "
+                        f"2..SCHEMA_VERSION({version}) — a new kind must "
+                        f"arrive WITH a SCHEMA_VERSION bump"))
+            newest = max([v for v in min_version.values()
+                          if isinstance(v, int)], default=1)
+            if newest < version:
+                out.append(self.hit(
+                    source, anchor("SCHEMA_VERSION"),
+                    f"SCHEMA_VERSION is {version} but no kind is "
+                    f"introduced at v{version} (newest KIND_MIN_VERSION "
+                    f"is {newest}) — a version bump must ride the kind "
+                    f"that motivates it"))
+        if isinstance(required, dict):
+            for kind in sorted(set(required) - set(kinds)):
+                out.append(self.hit(
+                    source, anchor("REQUIRED_FIELDS"),
+                    f"REQUIRED_FIELDS pins fields for {kind!r}, which is "
+                    f"not in EVENT_KINDS — stale entry"))
+
+    def _check_sites(self, source: LintSource, reg: Dict[str, object],
+                     out: List[Violation]) -> None:
+        kinds = reg.get("EVENT_KINDS", frozenset())
+        fault_kinds = reg.get("FAULT_KINDS", frozenset())
+        required: Dict[str, frozenset] = reg.get("REQUIRED_FIELDS", {})
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            if fn is None:
+                continue
+            emitter = _EMITTERS.get(fn.split(".")[-1])
+            if emitter is None:
+                continue
+            kind_index, fault_only = emitter
+            if len(node.args) > kind_index:
+                kind_arg = node.args[kind_index]
+            else:  # every emitter names its kind parameter `kind`
+                kind_arg = next((kw.value for kw in node.keywords
+                                 if kw.arg == "kind"), None)
+            if not (isinstance(kind_arg, ast.Constant)
+                    and isinstance(kind_arg.value, str)):
+                continue  # forwarding wrappers pass the kind through
+            kind = kind_arg.value
+            if kind not in kinds:
+                out.append(self.hit(
+                    source, node,
+                    f"`{fn}` journals unregistered kind {kind!r} — "
+                    f"register it in obs/journal.py EVENT_KINDS with a "
+                    f"KIND_MIN_VERSION entry and a SCHEMA_VERSION bump "
+                    f"(additive evolution)"))
+                continue
+            if fault_only and kind not in fault_kinds:
+                out.append(self.hit(
+                    source, node,
+                    f"log_fault({kind!r}) — not a FAULT_KINDS member, so "
+                    f"the faults.json view would silently drop it; use "
+                    f"log_event for non-fault kinds"))
+            need = required.get(kind)
+            if not need:
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **splat: the field set is open (runtime-checked)
+            given = {kw.arg for kw in node.keywords}
+            missing = sorted(set(need) - given)
+            if missing:
+                out.append(self.hit(
+                    source, node,
+                    f"{kind!r} event missing required field(s) {missing} "
+                    f"(obs/journal.py REQUIRED_FIELDS) — the event would "
+                    f"fail validate_event at runtime"))
+
+
+# =========================================================================
+# GL203 — checkpoint-evolution coverage
+# =========================================================================
+
+class GL203CheckpointEvolution(Rule):
+    id = "GL203"
+    title = "TrainState field without a checkpoint-evolution rule"
+    invariant = (
+        "Every TrainState field generation restores through the retry "
+        "ladder in train/checkpoint.py: a field added with a default "
+        "(mix_pending, mix_ages, telemetry, membership — the evolution "
+        "fields) must either be stripped around save/restore or appear in "
+        "a ladder generation's drop set, or every pre-existing checkpoint "
+        "fails resume with `Dict key mismatch` — the bug class patched "
+        "reactively in PRs 6, 9, and 14.  The ladder must not drop "
+        "non-existent or non-defaulted fields (a stale generation masks "
+        "real corruption), and the save-side strip set must equal the "
+        "restore-side strip set (an asymmetric strip breaks EVERY "
+        "restore).  Checked wherever `restore_checkpoint` is defined, "
+        "against the TrainState dataclass in the same module or the "
+        "imported sibling `state` module."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        restore = self._find_def(source.tree, "restore_checkpoint")
+        if restore is None:
+            return []
+        out: List[Violation] = []
+        fields = self._train_state_fields(source)
+        if fields is None:
+            out.append(self.hit(
+                source, restore,
+                "restore_checkpoint defined but TrainState was found "
+                "neither in this module nor in the imported `state` "
+                "sibling — the evolution coverage cannot be proven"))
+            return out
+        all_fields, defaulted = fields
+        ladder_node, drops = self._ladder(restore)
+        drops_union: Set[str] = set().union(*drops) if drops else set()
+        restore_strips = self._strips(restore)
+        save_def = self._find_def(source.tree, "save_checkpoint")
+        covered = restore_strips | drops_union
+        for f in sorted(defaulted - covered):
+            out.append(self.hit(
+                source, restore,
+                f"TrainState field `{f}` (defaulted evolution field) has "
+                f"no reconciliation rule: not stripped around "
+                f"save/restore, and no retry-ladder generation drops it — "
+                f"older checkpoints missing `{f}` will fail resume (the "
+                f"PR-6/9/14 bug class); add a ladder generation or strip "
+                f"it like telemetry"))
+        for f in sorted(drops_union - all_fields):
+            out.append(self.hit(
+                source, ladder_node or restore,
+                f"restore retry ladder drops `{f}`, which is not a "
+                f"TrainState field — stale generation"))
+        for f in sorted((drops_union & all_fields) - defaulted):
+            out.append(self.hit(
+                source, ladder_node or restore,
+                f"restore retry ladder drops core field `{f}` (no "
+                f"default) — dropping a founding field masks real "
+                f"checkpoint corruption"))
+        for f in sorted(restore_strips - all_fields):
+            out.append(self.hit(
+                source, restore,
+                f"restore strips `{f}`, which is not a TrainState field "
+                f"— stale strip"))
+        if save_def is not None:
+            save_strips = self._strips(save_def)
+            if save_strips != restore_strips:
+                out.append(self.hit(
+                    source, save_def,
+                    f"save strips {sorted(save_strips)} but restore "
+                    f"strips {sorted(restore_strips)} — asymmetric strip "
+                    f"sets make every restore template mismatch what save "
+                    f"wrote"))
+        return out
+
+    @staticmethod
+    def _find_def(tree: ast.AST, name: str) -> Optional[ast.AST]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == name:
+                return node
+        return None
+
+    @staticmethod
+    def _fields_of(tree: ast.AST
+                   ) -> Optional[Tuple[Set[str], Set[str]]]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == "TrainState":
+                all_fields: Set[str] = set()
+                defaulted: Set[str] = set()
+                for st in node.body:
+                    if isinstance(st, ast.AnnAssign) \
+                            and isinstance(st.target, ast.Name):
+                        all_fields.add(st.target.id)
+                        if st.value is not None:
+                            defaulted.add(st.target.id)
+                return all_fields, defaulted
+        return None
+
+    def _train_state_fields(self, source: LintSource
+                            ) -> Optional[Tuple[Set[str], Set[str]]]:
+        local = self._fields_of(source.tree)
+        if local is not None:
+            return local
+        # `from .state import TrainState` -> the sibling module's file
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.ImportFrom) or node.module is None:
+                continue
+            if not any(a.name == "TrainState" for a in node.names):
+                continue
+            src_path = pathlib.Path(source.path)
+            if not src_path.is_absolute():
+                src_path = REPO_ROOT / src_path
+            sibling = src_path.parent / (node.module.split(".")[-1] + ".py")
+            if sibling.exists():
+                try:
+                    return self._fields_of(ast.parse(sibling.read_text()))
+                except SyntaxError:
+                    return None
+        return None
+
+    @staticmethod
+    def _ladder(restore: ast.AST
+                ) -> Tuple[Optional[ast.AST], List[Set[str]]]:
+        """The retry ladder: the first For whose iterable folds to a
+        sequence of string-tuple generations."""
+        for node in ast.walk(restore):
+            if not isinstance(node, ast.For):
+                continue
+            try:
+                gens = const_eval(node.iter, {})
+            except _REGISTRY_FOLD_ERRORS:
+                continue
+            if not isinstance(gens, (list, tuple)) or not gens:
+                continue
+            if all(isinstance(g, (list, tuple, set, frozenset))
+                   and all(isinstance(f, str) for f in g) for g in gens):
+                return node, [set(g) for g in gens]
+        return None, []
+
+    @staticmethod
+    def _strips(fn_node: ast.AST) -> Set[str]:
+        """Fields replaced with the empty tuple (`x.replace(f=(), ...)`)
+        inside ``fn_node`` — the telemetry-style strip set."""
+        strips: Set[str] = set()
+        for node in ast.walk(fn_node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "replace"):
+                continue
+            for kw in node.keywords:
+                if kw.arg is not None and isinstance(kw.value, ast.Tuple) \
+                        and not kw.value.elts:
+                    strips.add(kw.arg)
+        return strips
+
+
+CONTRACT_RULES: Tuple[Rule, ...] = (
+    GL201SyncBudget(),
+    GL202JournalSchema(),
+    GL203CheckpointEvolution(),
+)

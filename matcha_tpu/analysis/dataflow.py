@@ -403,7 +403,7 @@ _FOLD_CALLS = {
     "range": range, "len": len, "sorted": sorted, "list": list,
     "tuple": tuple, "set": set, "enumerate": enumerate, "zip": zip,
     "min": min, "max": max, "abs": abs, "sum": sum, "reversed": reversed,
-    "divmod": divmod,
+    "divmod": divmod, "frozenset": frozenset, "dict": dict,
 }
 
 _BINOPS = {
@@ -509,7 +509,22 @@ def const_eval(node: ast.AST, env: Optional[Dict[str, object]] = None):
             if fn not in _FOLD_CALLS or n.keywords:
                 raise NotFoldable(f"call to `{fn or '?'}`")
             return _FOLD_CALLS[fn](*[ev(a, scope) for a in n.args])
-        if isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        if isinstance(n, ast.Dict):
+            # dict literals, including `{**a, **b}` merge unpacking — the
+            # shape obs/journal.py builds KIND_MIN_VERSION with (GL202
+            # folds the registry instead of importing the module)
+            merged: Dict[object, object] = {}
+            for k, v in zip(n.keys, n.values):
+                if k is None:
+                    sub = ev(v, scope)
+                    if not isinstance(sub, dict):
+                        raise NotFoldable("`**` unpack of a non-dict")
+                    merged.update(sub)
+                else:
+                    merged[ev(k, scope)] = ev(v, scope)
+            return merged
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.SetComp,
+                          ast.DictComp)):
             out: List[object] = []
 
             def run(gens: Sequence[ast.comprehension],
@@ -518,7 +533,10 @@ def const_eval(node: ast.AST, env: Optional[Dict[str, object]] = None):
                 if budget[0] < 0:
                     raise NotFoldable("operation budget exceeded")
                 if not gens:
-                    out.append(ev(n.elt, scope))
+                    if isinstance(n, ast.DictComp):
+                        out.append((ev(n.key, scope), ev(n.value, scope)))
+                    else:
+                        out.append(ev(n.elt, scope))
                     return
                 g = gens[0]
                 for item in ev(g.iter, scope):
@@ -528,6 +546,8 @@ def const_eval(node: ast.AST, env: Optional[Dict[str, object]] = None):
                         run(gens[1:], inner)
 
             run(n.generators, dict(scope))
+            if isinstance(n, ast.DictComp):
+                return dict(out)
             return set(out) if isinstance(n, ast.SetComp) else out
         raise NotFoldable(type(n).__name__)
 
@@ -552,7 +572,8 @@ def free_names(node: ast.AST) -> Set[str]:
     comprehension-bound targets — what ``const_eval`` needs from its env."""
     bound: Set[str] = set()
     for n in ast.walk(node):
-        if isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.SetComp,
+                          ast.DictComp)):
             for g in n.generators:
                 for t in ast.walk(g.target):
                     if isinstance(t, ast.Name):

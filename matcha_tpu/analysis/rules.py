@@ -409,11 +409,13 @@ CORE_RULES: Tuple[Rule, ...] = (
     GL006SwallowedExceptions(),
 )
 
-# imported at the bottom so spmd_rules (which imports Rule via engine and
-# the dataflow layer) can never cycle back into a half-initialized module
+# imported at the bottom so spmd_rules / contracts (which import Rule via
+# engine and the dataflow layer) can never cycle back into a
+# half-initialized module
+from .contracts import CONTRACT_RULES  # noqa: E402
 from .spmd_rules import SPMD_RULES  # noqa: E402
 
-ALL_RULES: Tuple[Rule, ...] = CORE_RULES + SPMD_RULES
+ALL_RULES: Tuple[Rule, ...] = CORE_RULES + SPMD_RULES + CONTRACT_RULES
 
 
 def rules_by_id(ids: Optional[Sequence[str]] = None) -> Tuple[Rule, ...]:

@@ -128,6 +128,7 @@ class TrainResult:
         self.history = history  # list of per-epoch dicts
 
 
+# graftcontract: root
 def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     if config.plan:
         # resolve the plan artifact's schedule choice (graph, budget, seed)
@@ -599,8 +600,11 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         # worker availability composes multiplicatively: the fault plan's
         # expectation × the membership occupancy (a vacant slot is simply
         # dead to the mixing, whatever the fault plan thought of it)
+        # graftcontract: sync — fault-plan availability expectations are
+        # pure host numpy (no device value can reach this composition)
         fault_alive = (np.asarray(faults.expected_alive(), np.float64)
                        if faults is not None else None)
+        # graftcontract: sync — controller occupancy mask, host-side state
         member_alive = (np.asarray(elastic_ctl.alive_mask(), np.float64)
                         if elastic_ctl is not None else None)
         if fault_alive is None:
@@ -616,6 +620,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             schedule.laplacians(), schedule.probs, plan_alpha * stale_scale,
             overlap=config.overlap, wire_dtype=config.wire_dtype,
             worker_alive=worker_alive,
+            # graftcontract: sync — host fault-plan link expectation
             link_up=(np.asarray(faults.expected_link_up(), np.float64)
                      if faults is not None else None),
             staleness=config.staleness, local_steps=config.local_steps,
@@ -781,10 +786,16 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                         cost_ledger.observe(_step_label, e_step,
                                             state, xb, yb, rng)
                     state, m = e_step(state, xb, yb, rng)
+                    # graftcontract: sync — the per-batch python path reads
+                    # every step's metrics back by design (debug mode;
+                    # scan_epoch=True is the zero-per-batch-sync path)
+                    m = {k: float(np.asarray(v)) for k, v in m.items()}
                     for k, v in m.items():
-                        sums[k] = sums.get(k, 0.0) + float(v)
+                        sums[k] = sums.get(k, 0.0) + v
                     count += 1
                 epoch_metrics = {k: v / count for k, v in sums.items()}
+            # graftcontract: sync — THE one deliberate per-epoch barrier
+            # (wall-clock truth + everything below rides this sync)
             jax.block_until_ready(state.params)
         epoch_time = time.time() - t0
 
@@ -797,9 +808,13 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             # exempt — they are guaranteed a heal (params) + row reset
             # (momentum/carry) at revival.  Stragglers are never healed, so
             # their state must stay finite like anyone else's.
+            # graftcontract: sync — divergence-detector readback, riding
+            # the epoch-boundary barrier that already completed above
             finite_rows = np.asarray(finite_check(state))
             if faults is not None:
-                cursor = max(min(int(state.step) - 1,
+                # graftcontract: sync — schedule-cursor read for the fault
+                # quarantine exemption (one scalar, already materialized)
+                cursor = max(min(int(np.asarray(state.step)) - 1,
                                  faults.iterations - 1), 0)
                 relevant = faults.dead_alive[cursor] > 0
             else:
@@ -820,6 +835,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                         # last-good state, resumable with --resume
                         path = f"{config.savePath}/{config.name}_emergency"
                         with annotate("matcha/checkpoint"):
+                            # graftcontract: sync — emergency checkpoint:
+                            # the last good state must reach disk now
                             save_checkpoint(path, snapshot, epoch - 1,
                                             schedule=schedule0,
                                             membership=_membership_sidecar())
@@ -922,6 +939,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                     disagreement=epoch_metrics["disagreement"],
                 )
                 if config.save:
+                    # graftcontract: sync — divergence-abort flush: the
+                    # curve leading into the blow-up must survive on disk
                     recorder.save()
                 budget_note = (f", {recoveries_used}/{config.max_recoveries} "
                                f"recoveries exhausted"
@@ -960,7 +979,9 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 # NaN gaps instead of silently poisoning the tacc series and
                 # the test_*_mean history the sweep/verify consumers read
                 if faults is not None:
-                    cur = max(min(int(state.step) - 1,
+                    # graftcontract: sync — eval-side cursor read, same
+                    # quarantine exemption as the train-side detector
+                    cur = max(min(int(np.asarray(state.step)) - 1,
                                   faults.iterations - 1), 0)
                     eval_alive = faults.dead_alive[cur] > 0
                     if member_alive_np is not None:
@@ -982,12 +1003,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         history.append({
             "epoch": epoch,
             **epoch_metrics,
-            "test_acc_mean": float(np.mean(test_acc[eval_alive])
-                                   if eval_alive is not None
-                                   and eval_alive.any() else np.mean(test_acc)),
-            "test_loss_mean": float(np.mean(test_loss[eval_alive])
-                                    if eval_alive is not None
-                                    and eval_alive.any() else np.mean(test_loss)),
+            "test_acc_mean": _masked_mean(test_acc, eval_alive),
+            "test_loss_mean": _masked_mean(test_loss, eval_alive),
             "epoch_time": epoch_time,
             "comm_time": comm_time,
             "comm_encode_time": comm_encode_time,
@@ -1002,9 +1019,10 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                                                    config.num_workers)))
 
         if tel_spec is not None:
-            # the ONE host read of the in-graph accumulator, riding the
-            # epoch-boundary sync that already happened above; the
-            # accumulator then resets for the next epoch's window
+            # graftcontract: sync — the ONE host read of the in-graph
+            # telemetry accumulator, riding the epoch-boundary barrier
+            # that already happened above; the accumulator then resets
+            # for the next epoch's window
             tel = telemetry_flush(state.telemetry)
             # the per-worker stats ride the same flush but feed the
             # heartbeat, not the telemetry event (its scalar schema is
@@ -1025,6 +1043,8 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 peak = max((e.get("peak_bytes") or 0.0
                             for e in cost_ledger.programs), default=0.0) \
                     if cost_ledger is not None else 0.0
+                # graftcontract: sync — per-epoch heartbeat emit (host
+                # values already read at this boundary; file write only)
                 hb = health_emitter.beat(
                     epoch=epoch, step=(epoch + 1) * bpe,
                     steps=tel["steps"], epoch_time=epoch_time,
@@ -1038,10 +1058,14 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
 
         if config.save and recorder.epochs_recorded % 10 == 0:
             with annotate("matcha/recorder_flush"):
-                recorder.save()  # flush cadence parity (train_mpi.py:159-160)
+                # graftcontract: sync — recorder flush cadence parity
+                # (train_mpi.py:159-160); append-only CSV + journal write
+                recorder.save()
         if config.checkpoint_every and (epoch + 1) % config.checkpoint_every == 0:
             path = f"{config.savePath}/{config.name}_ckpt"
             with annotate("matcha/checkpoint"):
+                # graftcontract: sync — periodic checkpoint write at the
+                # configured cadence (materializes the full TrainState)
                 save_checkpoint(path, state, epoch, schedule=schedule0,
                                 membership=_membership_sidecar())
             recorder.log_event("checkpoint", epoch=epoch, path=path)
@@ -1087,6 +1111,17 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         with annotate("matcha/recorder_flush"):
             recorder.save()
     return TrainResult(state, recorder, schedule, history)
+
+
+def _masked_mean(values, alive) -> float:
+    """Mean of the non-quarantined entries of a per-worker eval series —
+    the history's ``test_*_mean`` rule (quarantined/vacant rows are NaN
+    gaps, not zeros)."""
+    if alive is not None and alive.any():
+        values = values[alive]
+    # graftcontract: sync — host numpy mean over eval arrays the per-batch
+    # eval readback already materialized
+    return float(np.mean(values))
 
 
 def _config_snapshot(config: TrainConfig) -> Dict:
@@ -1286,6 +1321,8 @@ def _run_epoch_scanned(scan_step, state, loader: WorkerBatches, epoch: int,
         xs, ys = zip(*batches)
         state, metrics = observed(state, jnp.asarray(np.stack(xs)),
                                   jnp.asarray(np.stack(ys)))
+        # graftcontract: sync — whole-epoch metrics readback: one forced
+        # materialization per epoch, after the scan returns
         return state, {k: float(np.mean(v)) for k, v in metrics.items()}
 
     sums: Dict[str, float] = {}
@@ -1297,6 +1334,8 @@ def _run_epoch_scanned(scan_step, state, loader: WorkerBatches, epoch: int,
     def flush(metrics, n):
         nonlocal total
         for k, v in metrics.items():
+            # graftcontract: sync — per-chunk metrics force, deliberately
+            # AFTER the next segment's dispatch (the two-deep pipeline)
             sums[k] = sums.get(k, 0.0) + float(np.sum(v))
         total += n
 
@@ -1339,9 +1378,13 @@ def _evaluate_in_batches(evaluate, state, x_test, y_test, batch: int = 512,
             ledger.observe("evaluate", evaluate,
                            state.params, state.batch_stats, xl, yl)
         l, a = evaluate(state.params, state.batch_stats, xl, yl)
+        # graftcontract: sync — per-eval-batch readback (eval cadence:
+        # eval_every epochs, ≤ ceil(test/batch)+1 compiled shapes)
         losses.append(np.asarray(l))
+        # graftcontract: sync — second half of the same eval readback
         accs.append(np.asarray(a))
         weights.append(len(yl))
+    # graftcontract: sync — host batch-size weights (never device values)
     w = np.asarray(weights, np.float64)[:, None]
     return (
         (np.stack(losses) * w).sum(0) / w.sum(),

@@ -126,9 +126,13 @@ def _assert_stamp_schema(data, where):
         assert {"rule", "path", "line", "col", "message"} <= set(v), (
             f"{where}: malformed violation entry {v}")
     rule_ids = {r["id"] for r in data["rules"]}
-    assert {"GL001", "GL101"} <= rule_ids, (
-        f"{where}: stamp rule set {sorted(rule_ids)} is missing the core or "
-        f"SPMD family — it was not produced by the full default run")
+    # schema v2 (ISSUE 15): a full-run stamp must carry the graftcontract
+    # family next to the core + SPMD families — a stamp without GL201 was
+    # produced by a pre-contract tree and is not evidence for this one
+    assert {"GL001", "GL101", "GL201"} <= rule_ids, (
+        f"{where}: stamp rule set {sorted(rule_ids)} is missing the core, "
+        f"SPMD, or graftcontract family — it was not produced by the full "
+        f"default run")
     assert data["clean"] == (not data["violations"]), where
 
 
@@ -152,3 +156,29 @@ def test_lint_stamp_renderer_emits_the_pinned_schema():
     data = json.loads(render_json(violations, sources, ALL_RULES))
     _assert_stamp_schema(data, "render_json")
     assert data["files_checked"] == 1
+
+
+def test_contracts_stamp_schema():
+    """benchmarks/tpu_session.sh step 0.1 also records the graftcontract
+    verdict (`--rules GL201,GL202,GL203 --format json`) next to the
+    graftlint stamp: pin that shape too — committed stamps and the
+    renderer both — so the sync-budget evidence cannot silently change
+    schema between sessions."""
+    import json
+
+    from matcha_tpu.analysis import lint_paths, render_json, rules_by_id
+
+    contract_rules = rules_by_id(["GL201", "GL202", "GL203"])
+
+    def check(data, where):
+        assert _STAMP_KEYS <= set(data), where
+        assert {r["id"] for r in data["rules"]} == \
+            {"GL201", "GL202", "GL203"}, where
+        assert data["clean"] == (not data["violations"]), where
+
+    for stamp in sorted((REPO / "benchmarks").glob("contracts_stamp*.json")):
+        check(json.loads(stamp.read_text()), stamp.name)
+    violations, sources = lint_paths(
+        ["lint_tpu.py"], contract_rules, baseline=set(), repo_root=REPO)
+    check(json.loads(render_json(violations, sources, contract_rules)),
+          "render_json")
