@@ -28,10 +28,19 @@ Everything is seed-deterministic, so the journaled estimate recovers θ
 up to the ridge bias, and the companion artifact
 ``benchmarks/measured_link_costs_ring8.json`` pins the PL009–011 surface.
 
+The v6 serve plane (ISSUE 17) rides the same run through the REAL
+``TrainerHarness`` boundary hook: promotion every 4 epochs (one
+``promotion`` event — the consensus-mean snapshot promoted at epoch 4,
+mid-churn), and one hot-swap ``control`` document (budget 0.5 → 0.35)
+published at the epoch-6 boundary — after the rejoin re-fold, so the
+membership pins stay untouched — applied as a value update with zero
+retraces, carrying the re-based drift prediction for replay parity.
+
 Regenerate after a journal schema bump (the v1→v2 bump of ISSUE 8 added
 ``compile`` events from the cost ledger; ISSUE 9 added ``membership``;
 the v2→v3 bump of ISSUE 10 added ``heartbeat`` and ``anomaly``; the
-v3→v4 bump of ISSUE 11 added ``attribution``):
+v3→v4 bump of ISSUE 11 added ``attribution``; the v5→v6 bump of
+ISSUE 17 added ``control`` and ``promotion``):
 
     JAX_PLATFORMS=cpu python benchmarks/make_reference_journal.py
 """
@@ -49,6 +58,13 @@ sys.path.insert(0, REPO)
 #: the "heterogeneous links" the committed attribution event must recover
 PLANTED_MATCHING_SECONDS = [0.02, 0.06]
 PLANTED_BASE_SECONDS = 0.01
+
+#: the v6 serve-plane pins: the hot-swap document's target budget and the
+#: epoch boundary it is published at (after the epoch-5 rejoin re-fold),
+#: and the promotion cadence (one promotion, at epoch 4)
+SWAP_BUDGET = 0.35
+SWAP_EPOCH = 6
+PROMOTE_EVERY = 4
 
 
 def main() -> int:
@@ -75,10 +91,31 @@ def main() -> int:
             {"kind": "straggler", "worker": 5, "start": 0, "period": 4},
         ]},
     )
+    # v6 pin: the REAL serve plane as the boundary hook — the committed
+    # `control` and `promotion` events come from TrainerHarness itself,
+    # not hand-written dicts.  The control document is published at the
+    # epoch-6 boundary through the atomic writer, so the journal commits
+    # one applied value-scope swap (budget 0.5 → 0.35) and one promotion
+    # (epoch 4, the consensus mean promoted mid-churn).
+    from matcha_tpu.serve import TrainerHarness, write_control
+
+    control_path = os.path.join(root, "control.json")
+    harness = TrainerHarness({
+        "control_path": control_path,
+        "serving_dir": os.path.join(root, "serving"),
+        "promote_every": PROMOTE_EVERY, "eval_batch": 32,
+    })
+
+    def boundary_hook(seam):
+        if seam.epoch == SWAP_EPOCH:
+            write_control(control_path,
+                          {"version": 1, "budget": SWAP_BUDGET})
+        harness.on_boundary(seam)
+
     # savePath stays the default relative "runs" so the journaled config
     # snapshot carries no machine-specific temp path — run from a tmp cwd
     os.chdir(root)
-    train(cfg)
+    train(cfg, boundary_hook=boundary_hook)
     src = os.path.join(root, "runs", "ring8_mlp", "events.jsonl")
     dst = os.path.join(REPO, "benchmarks", "events_ring8.jsonl")
     shutil.copyfile(src, dst)
@@ -99,6 +136,17 @@ def main() -> int:
     )
 
     events = read_journal(dst)
+    # the serve plane actually landed, through the real code paths: one
+    # applied hot-swap at the pinned boundary (with the re-based drift
+    # prediction for replay parity), one promotion, zero retraces
+    [swap] = [e for e in events if e["kind"] == "control"]
+    assert (swap["action"], swap["applied"], swap["epoch"]) \
+        == ("apply", True, SWAP_EPOCH), swap
+    assert swap["fields"]["budget"]["budget"] == SWAP_BUDGET
+    assert 0.0 < swap["predicted"]["rho"] < 1.0, swap
+    [promo] = [e for e in events if e["kind"] == "promotion"]
+    assert (promo["action"], promo["epoch"]) == ("promote", PROMOTE_EVERY)
+    assert not [e for e in events if e["kind"] == "retrace"]
     start = next(e for e in events if e["kind"] == "run_start")
     spe = int(start["predicted"]["steps_per_epoch"])
     epochs = sorted(e["epoch"] for e in events if e["kind"] == "epoch")

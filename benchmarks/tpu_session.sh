@@ -172,6 +172,18 @@ for st in "1 1" "2 1" "2 4"; do
         --local-steps "$2" --no-comm-split >> benchmarks/async_bench_r7.json
 done
 
+# 1.95 serve_r8 (ISSUE 17: the production run controller's first on-TPU
+#      evidence).  One supervised saved run with promotion every epoch, a
+#      budget hot-swap published before launch (must journal as applied
+#      with zero retraces — the zero-retrace contract on the real
+#      backend), /healthz and /promoted answered over HTTP, and the stop
+#      document draining the daemon to exit 0; the probe renders the
+#      endpoint bodies and the journaled control/promotion events as the
+#      committable markdown artifact.
+timeout -k 30 600 python benchmarks/serve_probe.py --round 8 \
+    --out benchmarks/serve_r8.md \
+    || echo "serve_r8: controller probe failed (see benchmarks/serve_r8.md)"
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist

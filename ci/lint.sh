@@ -56,6 +56,15 @@
 #                    then a plan_tpu.py rho --staleness smoke — the
 #                    staleness-composed artifact must pass its own
 #                    planlint self-check and report the damped rho < 1
+#  14. serve lane + smoke  production run controller (supervised daemon,
+#                    control-doc hot-swap, promotion, endpoint), as
+#                    pytest (marker: serve — includes the slow kill -9
+#                    crash-survival and rollback e2e); then a live
+#                    serve_tpu.py daemon on a tiny MLP ring-4 run —
+#                    /healthz and /promoted must answer over HTTP, a
+#                    pre-published budget document must journal as
+#                    applied with zero retraces, and a stop document
+#                    must drain the daemon to exit 0
 #
 # Fast pre-commit variant: lint only what changed vs a ref —
 #
@@ -202,5 +211,104 @@ assert 0 < stale["stale_alpha_scale"] < 1, stale
 assert stale["rho_at_scaled_alpha"] < 1.0, stale
 PY
 rm -rf "$ASYNC_DIR"
+
+echo "== serve pytest lane (incl. slow crash-survival e2e) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m serve -p no:cacheprovider || rc=1
+
+echo "== serve smoke (live daemon: hot-swap, /healthz, /promoted, stop) =="
+SERVE_DIR="$(mktemp -d)"
+cat > "$SERVE_DIR/config.json" <<'JSON'
+{"name": "servesmoke", "model": "mlp", "dataset": "synthetic",
+ "dataset_kwargs": {"num_train": 128, "num_test": 32},
+ "num_workers": 4, "graphid": null, "topology": "ring",
+ "batch_size": 16, "epochs": 100000, "lr": 0.05, "warmup": false,
+ "matcha": true, "budget": 0.5, "seed": 3, "checkpoint_every": 1,
+ "eval_every": 0, "measure_comm_split": false}
+JSON
+# publish the hot-swap BEFORE launch: it must apply at the first epoch
+# boundary, as a journaled value update with zero retraces
+python serve_tpu.py control --out "$SERVE_DIR/control.json" \
+    --version 1 --budget 0.25 >/dev/null || rc=1
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python serve_tpu.py run \
+    --config "$SERVE_DIR/config.json" --save-path "$SERVE_DIR" \
+    --promote-every 1 --backoff 0.5 > "$SERVE_DIR/serve.log" 2>&1 &
+SERVE_PID=$!
+# the endpoint prints its ephemeral port at startup
+PORT=""
+for _ in $(seq 1 100); do
+    PORT="$(sed -n 's|.*endpoint on http://[^:]*:\([0-9]*\).*|\1|p' \
+        "$SERVE_DIR/serve.log" | head -1)"
+    [ -n "$PORT" ] && break
+    sleep 0.2
+done
+[ -n "$PORT" ] || { echo "serve smoke: endpoint never announced"; rc=1; }
+# poll /healthz until the first heartbeat lands (200), and /promoted
+# until the first promotion verifies (200) — both over real HTTP
+[ -z "$PORT" ] || python - "$PORT" <<'PY' || rc=1
+import json, sys, time, urllib.error, urllib.request
+port = sys.argv[1]
+
+def get(path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+    except OSError:
+        return None, None
+
+deadline = time.time() + 240
+ok = {}
+while time.time() < deadline and len(ok) < 2:
+    for path in ("/healthz", "/promoted"):
+        code, body = get(path)
+        if code == 200 and path not in ok:
+            ok[path] = body
+    time.sleep(0.5)
+assert "/healthz" in ok, "healthz never went 200"
+assert ok["/healthz"]["ok"] and ok["/healthz"]["verdict"] == 0
+assert "/promoted" in ok, "promoted never went 200"
+assert ok["/promoted"]["verified"]
+code, body = get("/status")
+assert code == 200 and body["trainer_alive"], body
+PY
+# clean shutdown through the operator path: a stop document drains the
+# run and the daemon exits 0 (epochs is set far out of reach, so the
+# stop document is the only way this run ends)
+python serve_tpu.py control --out "$SERVE_DIR/control.json" \
+    --version 2 --stop >/dev/null || rc=1
+for _ in $(seq 1 600); do
+    kill -0 "$SERVE_PID" 2>/dev/null || break
+    sleep 0.2
+done
+if kill -0 "$SERVE_PID" 2>/dev/null; then
+    echo "serve smoke: daemon ignored the stop document"
+    kill -9 "$SERVE_PID" 2>/dev/null
+    rc=1
+fi
+SERVE_RC=0
+wait "$SERVE_PID" || SERVE_RC=$?
+[ "$SERVE_RC" -eq 0 ] || { \
+    echo "serve smoke: daemon exit $SERVE_RC"; cat "$SERVE_DIR/serve.log"; \
+    rc=1; }
+# the journal must carry the applied hot-swap, the stop, at least one
+# promotion — and no retrace events (the zero-retrace contract)
+python - "$SERVE_DIR/servesmoke_mlp/events.jsonl" <<'PY' || rc=1
+import sys
+from matcha_tpu.obs import read_journal
+events = read_journal(sys.argv[1])
+controls = [(e["action"], e["applied"]) for e in events
+            if e["kind"] == "control"]
+assert ("apply", True) in controls, controls
+assert ("stop", True) in controls, controls
+assert any(e["kind"] == "promotion" for e in events)
+assert not [e for e in events if e["kind"] == "retrace"]
+PY
+# the serving directory must audit clean end-to-end
+python serve_tpu.py verify "$SERVE_DIR/servesmoke_serving" \
+    >/dev/null || rc=1
+rm -rf "$SERVE_DIR"
 
 exit $rc

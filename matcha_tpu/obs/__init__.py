@@ -72,6 +72,7 @@ from .drift import DriftMonitor, compose_predicted_rho, drift_report
 from .health import (
     HeartbeatEmitter,
     fleet_status,
+    fleet_verdict,
     read_heartbeats,
     render_watch,
 )
@@ -111,6 +112,7 @@ __all__ = [
     "attribute_run",
     "build_timeline",
     "fleet_status",
+    "fleet_verdict",
     "capacity_report",
     "chip_peaks",
     "compose_predicted_rho",

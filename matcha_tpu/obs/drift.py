@@ -238,10 +238,13 @@ def drift_report(
     # `membership` re-plans (elastic join/leave/rejoin, §16) re-base the
     # live monitor exactly like fault-recovery α re-derivations — deferred
     # (hysteresis) membership events carry an empty `predicted` and are
-    # skipped here, matching the live monitor, which did not re-base either
+    # skipped here, matching the live monitor, which did not re-base either.
+    # `control` hot-swaps (serve plane, §22) carry the re-based prediction
+    # on their applied events for exactly this replay.
     rebases = [] if explicit_rho else sorted(
         ((int(e["epoch"]), e["predicted"]) for e in events
-         if e.get("kind") in ("alpha_rederived", "resume", "membership")
+         if e.get("kind") in ("alpha_rederived", "resume", "membership",
+                              "control")
          and isinstance(e.get("predicted"), dict)
          and e["predicted"].get("rho") is not None
          and "epoch" in e),

@@ -41,7 +41,8 @@ from .attribution import critical_path_report
 from .journal import append_journal_record, fmt_value, read_journal_tail
 
 __all__ = ["HeartbeatEmitter", "heartbeat_path", "read_heartbeats",
-           "worker_last_seen", "fleet_status", "render_watch"]
+           "worker_last_seen", "fleet_status", "fleet_verdict",
+           "render_watch"]
 
 
 def heartbeat_path(health_dir: str, host: str) -> str:
@@ -252,6 +253,30 @@ def fleet_status(source: str, now: Optional[float] = None,
                                            a["cause"])),
         "flagged": bool(anomalies),
     }
+
+
+def fleet_verdict(source: str, now: Optional[float] = None,
+                  deadline: float = 60.0, tail: int = 8,
+                  detector: Optional[AnomalyDetector] = None
+                  ) -> tuple:
+    """``(exit_code, status_or_None)`` — THE fleet health verdict.
+
+    The one place the ``watch --once`` exit-code contract lives, shared by
+    ``obs_tpu.py watch`` and the serve plane's ``/healthz`` endpoint so the
+    two can never disagree (pinned by a parity test):
+
+    * ``0`` — heartbeats exist and nothing is flagged (``status`` carried),
+    * ``1`` — heartbeats exist and something is flagged (``status``
+      carried, read ``status["anomalies"]`` for the findings),
+    * ``2`` — no heartbeat evidence at all (missing health dir or empty
+      files; ``status`` is ``None``).
+    """
+    try:
+        status = fleet_status(source, now=now, deadline=deadline, tail=tail,
+                              detector=detector)
+    except FileNotFoundError:
+        return 2, None
+    return (1 if status["flagged"] else 0), status
 
 
 def _fmt(v, digits: int = 3) -> str:

@@ -36,7 +36,7 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
            "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "V4_KINDS", "V5_KINDS",
-           "KIND_MIN_VERSION", "REQUIRED_FIELDS",
+           "V6_KINDS", "KIND_MIN_VERSION", "REQUIRED_FIELDS",
            "make_event", "validate_event", "Journal", "read_journal",
            "read_journal_tail", "resolve_journal_path", "latest_per_epoch",
            "epoch_series", "append_journal_record"]
@@ -52,11 +52,15 @@ __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
 #: ``gossip_backend="auto"`` resolves through (plan.cost
 #: choose_gossip_backend: chosen backend, per-backend byte models, the
 #: measured-vs-ceiling gate inputs), journaled so drift replay can score
-#: the choice against what the run measured.  Every pre-bump event
-#: validates verbatim under the v5 reader — old journals stay first-class
-#: sources.
-SCHEMA_VERSION = 5
-ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5})
+#: the choice against what the run measured.  v6 (ISSUE 17) adds the run
+#: controller's plane (matcha_tpu.serve): ``control`` — one hot-swap
+#: decision per control document (applied or rejected, with the reason and
+#: the epoch boundary it landed on), and ``promotion`` — one checkpoint-
+#: promotion pipeline decision (promote / rollback / retain with the
+#: gating held-out metric).  Every pre-bump event validates verbatim under
+#: the v6 reader — old journals stay first-class sources.
+SCHEMA_VERSION = 6
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5, 6})
 
 #: Every kind a journal may contain.  The five fault kinds keep their
 #: historical ``faults.json`` names so the view stays a pure filter.
@@ -83,15 +87,21 @@ V4_KINDS = frozenset({"attribution"})
 #: gossip-backend auto-selection record (requested/chosen/reason + the
 #: per-backend stream-byte entries and gate inputs from plan.cost).
 V5_KINDS = frozenset({"backend"})
+#: Kinds introduced by schema v6 (ISSUE 17) — the run controller's plane:
+#: ``control`` journals every hot-swap decision (an applied or rejected
+#: control document at an epoch boundary), ``promotion`` every checkpoint
+#: promotion / rollback the serving pipeline makes.
+V6_KINDS = frozenset({"control", "promotion"})
 #: Minimum envelope version per kind — the generalized "a vK kind claiming
 #: an earlier v is a lying envelope" rule.
 KIND_MIN_VERSION: Dict[str, int] = {
     **{k: 2 for k in V2_KINDS}, **{k: 3 for k in V3_KINDS},
-    **{k: 4 for k in V4_KINDS}, **{k: 5 for k in V5_KINDS}}
+    **{k: 4 for k in V4_KINDS}, **{k: 5 for k in V5_KINDS},
+    **{k: 6 for k in V6_KINDS}}
 EVENT_KINDS = frozenset({
     "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
     "retrace", "bench",
-}) | FAULT_KINDS | V2_KINDS | V3_KINDS | V4_KINDS | V5_KINDS
+}) | FAULT_KINDS | V2_KINDS | V3_KINDS | V4_KINDS | V5_KINDS | V6_KINDS
 
 #: Kind-specific payload keys an event must carry to validate.  Kinds not
 #: listed need only the envelope (v / kind / t).
@@ -144,6 +154,17 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # resolve_gossip_backend) — what `auto` chose and why, with the
     # planner's per-backend byte models when the selection actually ran
     "backend": frozenset({"requested", "chosen", "reason"}),
+    # v6 (ISSUE 17): one per control-document decision (serve.control) —
+    # ``action`` names what the doc asked for (budget / local_steps /
+    # staleness / stop / ...), ``applied`` whether it took effect, and
+    # ``reason`` why (validation failure text, or the applied summary).
+    # Rejected docs journal too: "never half-applied" is only auditable
+    # if the refusal is on the record.
+    "control": frozenset({"action", "applied", "reason", "epoch"}),
+    # v6 (ISSUE 17): one per promotion-pipeline decision (serve.promote) —
+    # ``action`` is promote / rollback / retain, ``metric`` the held-out
+    # eval value that gated it.
+    "promotion": frozenset({"action", "epoch", "metric"}),
 }
 
 

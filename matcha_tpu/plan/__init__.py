@@ -31,6 +31,11 @@ burning a full training job per (topology, budget) point
 ``verify``
     Compare predicted disagreement decay against a Recorder CSV from a real
     run — the honesty check that keeps the prediction model falsifiable.
+
+``swap``
+    The run controller's online re-solve (DESIGN.md §22): a new budget
+    mapped onto a *committed* flag stream as first-moment-exact
+    per-matching re-weights, executable without a recompile.
 """
 
 from .artifact import PlanArtifact, apply_plan, load_plan, save_plan
@@ -62,6 +67,7 @@ from .spectral import (
     wire_disagreement_floor,
     wire_quantization_eps,
 )
+from .swap import resolve_budget_swap
 from .verify import (
     load_fault_ledger,
     load_recorder_disagreement,
@@ -90,6 +96,7 @@ __all__ = [
     "normalize_staleness",
     "parse_staleness_spec",
     "plan_candidate",
+    "resolve_budget_swap",
     "resolve_topology",
     "save_plan",
     "simulate_consensus",

@@ -1,0 +1,75 @@
+"""Online budget re-solve against a committed flag stream (DESIGN.md §22).
+
+A run controller cannot rebuild the schedule mid-run — the ``[T, M]``
+flag stream is baked into the compiled step, and re-sampling it would
+recompile the program and invalidate every checkpoint cursor.  What it
+*can* do is re-weight the stream: solve the MATCHA plan at the new
+budget, then map the result onto the committed flags as per-matching
+scale factors riding the ``serve.ControlKnobs`` device pytree.
+
+With committed probabilities ``p_old`` and executed mixing weight
+``α_base``, scaling matching ``j``'s flag row by ``row_scale[j] =
+p_new[j] / p_old[j]`` and the whole row by ``alpha_scale =
+α_new / α_base`` makes the *expected* executed Laplacian weight
+``α_new · p_new[j]`` — exactly the re-solved plan's first moment.  The
+second moment differs (firing times stay the committed draw), which is
+the documented approximation: the drift monitor re-bases to the
+re-solved (α, p) and keeps scoring the run against the plan in force.
+
+A matching the committed plan never activates (``p_old ≈ 0``) has no
+flags to re-weight — its ``row_scale`` is 0 and the re-solve's mass on
+it is reported in ``unreachable`` so the caller can journal the loss.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["resolve_budget_swap"]
+
+# below this, a committed probability is "never fires" — re-weighting a
+# dead row would divide by noise and the scaled weight could not execute
+_P_FLOOR = 1e-9
+
+
+def resolve_budget_swap(schedule, budget: float,
+                        iters: int = 3000) -> Dict:
+    """Re-solve (p, α) at ``budget`` and express it as control knobs.
+
+    Returns ``{"budget", "probs", "alpha", "rho", "row_scale",
+    "alpha_scale", "unreachable"}`` — ``row_scale``/``alpha_scale`` feed
+    ``seam.set_control``, ``probs``/``alpha`` feed ``seam.rebase_drift``,
+    and ``rho`` / ``unreachable`` are for the journaled control event.
+    """
+    if not 0 <= budget <= 1:
+        raise ValueError(f"budget must be in [0, 1], got {budget}")
+    from ..schedule import solve_activation_probabilities, solve_mixing_weight
+
+    laplacians = schedule.laplacians()
+    p_new = np.asarray(
+        solve_activation_probabilities(laplacians, float(budget),
+                                       iters=iters), np.float64)
+    alpha_new, rho_new = solve_mixing_weight(laplacians, p_new)
+
+    p_old = np.asarray(schedule.probs, np.float64)
+    alive = p_old > _P_FLOOR
+    row_scale = np.where(alive, p_new / np.where(alive, p_old, 1.0), 0.0)
+    # the mass the committed stream cannot deliver (new plan activates a
+    # matching the old plan retired) — honest effective probabilities are
+    # what the drift monitor must predict with
+    p_eff = np.where(alive, p_new, 0.0)
+    unreachable = float(np.sum(p_new[~alive]))
+
+    alpha_base = float(schedule.alpha)
+    alpha_scale = (float(alpha_new) / alpha_base) if alpha_base else 1.0
+    return {
+        "budget": float(budget),
+        "probs": p_eff,
+        "alpha": float(alpha_new),
+        "rho": float(rho_new),
+        "row_scale": row_scale,
+        "alpha_scale": float(alpha_scale),
+        "unreachable": unreachable,
+    }

@@ -1,0 +1,235 @@
+"""The run supervisor: own the train loop across process lifetimes.
+
+``Controller.run`` is the production daemon's core loop (DESIGN.md §22):
+it launches the trainer (``python -m matcha_tpu.serve.trainer``) as a
+subprocess, waits, and switches on the exit code:
+
+* ``0`` — the run completed (epochs exhausted, or a ``stop`` control
+  document drained it): supervision ends;
+* ``RESTART_EXIT`` — a deliberate restart requested by a restart-scope
+  control field: the supervisor merges the field into the config and
+  relaunches from the checkpoint, **without** charging the budget;
+* anything else — a crash: charged against ``restart_budget``, relaunch
+  after exponential backoff, resuming from the latest checkpoint (the
+  journal + CSVs extend; the resumed recorder state is byte-identical
+  to an uninterrupted run's — pinned by test).
+
+Supervisor-side decisions journal as v6 ``control`` events through
+``serve.control.journal_control`` — appended only **between** trainer
+lifetimes (the journal has one writer at a time; ``epoch=-1`` marks
+"supervisor-side, epoch unknown").  The trainer's own decisions ride its
+recorder inside the run.
+
+The controller is deliberately dumb about training: everything it knows
+arrives through files (spec out, journal/checkpoint/heartbeats back),
+so a kill -9 of either process loses nothing but uncheckpointed epochs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+from .control import (
+    CONTROL_BASENAME,
+    RESTART_EXIT,
+    RESTART_FIELDS,
+    journal_control,
+    load_control,
+)
+
+__all__ = ["Controller", "ServeConfig"]
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Everything the daemon needs beyond the training config itself."""
+
+    #: TrainConfig field dict (the trainer subprocess rebuilds it; paths
+    #: and plain JSON values only — a daemon's config must survive a file)
+    config: Dict
+    control_path: Optional[str] = None  # default: {savePath}/control.json
+    serving_dir: Optional[str] = None  # default: {savePath}/{name}_serving
+    promote_every: int = 0  # epochs between promotion evals; 0 disables
+    promote_margin: float = 0.0  # tolerated test_acc drop before rollback
+    promote_keep: int = 3
+    eval_batch: int = 256
+    restart_budget: int = 3  # crash relaunches before giving up
+    backoff: float = 1.0  # seconds, doubled per crash
+    backoff_max: float = 30.0
+
+    def __post_init__(self):
+        if not isinstance(self.config, dict):
+            raise ValueError("ServeConfig.config must be a dict of "
+                             "TrainConfig fields (it crosses a process "
+                             "boundary as JSON)")
+        if self.restart_budget < 0:
+            raise ValueError("restart_budget must be >= 0")
+        if self.promote_every < 0:
+            raise ValueError("promote_every must be >= 0")
+
+
+class Controller:
+    def __init__(self, serve: ServeConfig):
+        self.serve = serve
+        self.config = dict(serve.config)
+        # a daemon without a run folder has no journal, no heartbeats, no
+        # checkpoints — nothing to supervise with
+        self.config["save"] = True
+        save_path = self.config.get("savePath", "runs")
+        name = self.config.get("name", "experiment")
+        model = self.config.get("model", "resnet20")
+        self.run_dir = os.path.join(save_path, f"{name}_{model}")
+        self.ckpt_dir = os.path.join(save_path, f"{name}_ckpt")
+        self.journal_path = os.path.join(self.run_dir, "events.jsonl")
+        self.control_path = serve.control_path or os.path.join(
+            save_path, CONTROL_BASENAME)
+        self.serving_dir = serve.serving_dir or os.path.join(
+            save_path, f"{name}_serving")
+        self.spec_path = os.path.join(save_path, f"{name}_serve_spec.json")
+        self.restarts_used = 0
+        self.lifetimes = 0
+        self.last_exit: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._stopping = False
+
+    # ------------------------------------------------------------- plumbing
+    def _write_spec(self) -> None:
+        config = dict(self.config)
+        if os.path.isdir(self.ckpt_dir):
+            from ..train import latest_step
+
+            if latest_step(self.ckpt_dir) is not None:
+                config["resume"] = self.ckpt_dir
+        os.makedirs(os.path.dirname(os.path.abspath(self.spec_path)),
+                    exist_ok=True)
+        spec = {
+            "config": config,
+            "control_path": self.control_path,
+            "serving_dir": self.serving_dir,
+            "promote_every": self.serve.promote_every,
+            "promote_margin": self.serve.promote_margin,
+            "promote_keep": self.serve.promote_keep,
+            "eval_batch": self.serve.eval_batch,
+        }
+        tmp = self.spec_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(spec, f, indent=2, sort_keys=True)
+        os.replace(tmp, self.spec_path)
+
+    def _launch(self) -> subprocess.Popen:
+        self._write_spec()
+        self.lifetimes += 1
+        # the package may be running straight out of a checkout (not
+        # installed): make the child resolve `-m matcha_tpu...` from the
+        # same tree the supervisor imported, whatever the daemon's cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        return subprocess.Popen(
+            [sys.executable, "-m", "matcha_tpu.serve.trainer",
+             self.spec_path], env=env)
+
+    def _merge_restart_fields(self) -> Dict:
+        """Fold the current (valid) control document's restart-scope
+        fields into the config the next lifetime launches with."""
+        raw, problems = load_control(self.control_path)
+        if not raw or problems:
+            return {}
+        merged = {k: raw[k] for k in RESTART_FIELDS
+                  if k in raw and self.config.get(k) != raw[k]}
+        if not merged:
+            return {}
+        # same cross-field guard the trainer applies before requesting
+        # the restart: a merge that cannot construct a TrainConfig would
+        # crash-loop the next lifetime into the budget
+        try:
+            from ..train import TrainConfig
+
+            TrainConfig(**{**self.config, **merged})
+        except (ValueError, TypeError) as e:
+            journal_control(
+                self.journal_path, action="reject", applied=False,
+                reason=f"restart-scope merge invalid: {e}", epoch=-1)
+            return {}
+        self.config.update(merged)
+        return merged
+
+    # ----------------------------------------------------------- the daemon
+    # graftcontract: root
+    def run(self) -> int:
+        """Supervise until the run completes, the budget exhausts, or
+        ``shutdown()`` is called.  Returns the final exit code (0 on a
+        clean completion)."""
+        backoff = self.serve.backoff
+        while True:
+            self._proc = self._launch()
+            rc = self._proc.wait()
+            self._proc = None
+            self.last_exit = rc
+            if self._stopping or rc == 0:
+                return 0 if rc in (0, RESTART_EXIT) else rc
+            if rc == RESTART_EXIT:
+                merged = self._merge_restart_fields()
+                journal_control(
+                    self.journal_path, action="relaunch", applied=True,
+                    reason=f"restart-scope control fields {sorted(merged)} "
+                           f"merged; relaunching from checkpoint",
+                    epoch=-1, fields=merged)
+                backoff = self.serve.backoff  # deliberate, not a crash
+                continue
+            self.restarts_used += 1
+            if self.restarts_used > self.serve.restart_budget:
+                journal_control(
+                    self.journal_path, action="abort", applied=False,
+                    reason=f"trainer exit {rc}: restart budget "
+                           f"({self.serve.restart_budget}) exhausted",
+                    epoch=-1)
+                return rc
+            journal_control(
+                self.journal_path, action="restart", applied=True,
+                reason=f"trainer crashed with exit {rc} (attempt "
+                       f"{self.restarts_used}/{self.serve.restart_budget}, "
+                       f"backoff {backoff:.1f}s)",
+                epoch=-1)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, self.serve.backoff_max)
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Terminate the current trainer (SIGTERM, then SIGKILL after
+        ``timeout``) and end supervision — the signal-handler path."""
+        self._stopping = True
+        proc = self._proc
+        if proc is None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+    # ------------------------------------------------------------ reporting
+    def status(self) -> Dict:
+        """The ``/status`` payload: pure supervisor state + file facts
+        (no device reads — the controller has no device)."""
+        proc = self._proc
+        return {
+            "name": self.config.get("name", "experiment"),
+            "run_dir": self.run_dir,
+            "serving_dir": self.serving_dir,
+            "control_path": self.control_path,
+            "trainer_alive": proc is not None and proc.poll() is None,
+            "lifetimes": self.lifetimes,
+            "restarts_used": self.restarts_used,
+            "restart_budget": self.serve.restart_budget,
+            "last_exit": self.last_exit,
+            "stopping": self._stopping,
+        }

@@ -1,0 +1,271 @@
+"""Checkpoint promotion: consensus eval → signed manifest → serve dir.
+
+The promotion pipeline (DESIGN.md §22) turns a *training* artifact into
+a *serving* artifact with an auditable gate in between:
+
+1. snapshot the **consensus mean** — the average over the worker axis of
+   the replicated parameters (the model MATCHA's theory says the fleet
+   is contracting toward; the per-worker replicas are its scaffolding);
+2. evaluate it on the held-out test set;
+3. write the candidate (a flat-parameter ``.npz`` + per-candidate
+   manifest) into the serving directory and decide:
+
+   * **promote** — metric is no worse than the last promoted manifest's
+     (within ``margin``): the ``MANIFEST.json`` pointer atomically
+     re-points to the candidate;
+   * **rollback** — metric regressed: the pointer keeps the previous
+     promoted checkpoint (the candidate stays on disk for forensics,
+     subject to retention) and the decision journals as a v6
+     ``promotion`` event with ``action="rollback"``.
+
+Every manifest is *signed*: a sha256 over its canonical JSON (minus the
+signature field), which itself covers the artifact's content hash, the
+config fingerprint, and the journal offset — so a serving consumer can
+refuse a tampered or torn artifact without trusting the directory
+(``verify_promoted``; ``serve_tpu.py verify`` exits non-zero on it).
+Retention is orbax-GC-aware in spirit: the pointer's target is never
+pruned, everything else keeps the newest ``keep`` candidates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "MANIFEST_BASENAME",
+    "MANIFEST_FORMAT",
+    "PromotionTampered",
+    "config_fingerprint",
+    "consensus_metrics",
+    "current_manifest",
+    "decide_promotion",
+    "prune_serving",
+    "snapshot_consensus",
+    "verify_promoted",
+    "write_candidate",
+]
+
+MANIFEST_FORMAT = "matcha-promotion-manifest-v1"
+MANIFEST_BASENAME = "MANIFEST.json"
+
+
+class PromotionTampered(RuntimeError):
+    """A serving artifact failed verification — hash or signature
+    mismatch, or a manifest naming a file that does not exist.  Serving
+    consumers must treat this as "do not serve"."""
+
+
+def _canonical(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+def config_fingerprint(config) -> str:
+    """Stable hash of the run configuration a promoted artifact was
+    trained under — dataclass or plain dict (non-JSON leaves stringify:
+    identity, not round-tripping, is the job here)."""
+    snap = dataclasses.asdict(config) if dataclasses.is_dataclass(config) \
+        else dict(config)
+    return hashlib.sha256(
+        json.dumps(snap, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def snapshot_consensus(state, flattener) -> Dict[str, np.ndarray]:
+    """Host arrays of the consensus-mean model: the worker-axis mean of
+    the flat parameter matrix, plus each batch-stats leaf's mean (leaf
+    order is the tree-flatten order — deterministic for a fixed model).
+    Boundary-cadence host readback by design (promotion is I/O)."""
+    import jax
+
+    flat = flattener.flatten(state.params)
+    # graftcontract: sync — promotion snapshot readback: the consensus
+    # mean must reach the host to become a serving artifact (promotion
+    # cadence only, riding the epoch boundary's existing barrier)
+    arrays = {"params_flat": np.asarray(flat.mean(axis=0), np.float32)}
+    leaves = jax.tree_util.tree_leaves(state.batch_stats)
+    for i, leaf in enumerate(leaves):
+        # graftcontract: sync — same promotion-snapshot readback, the
+        # batch-stats leaves of the consensus mean
+        arrays[f"batch_stats_{i:03d}"] = np.asarray(
+            np.asarray(leaf, np.float32).mean(axis=0))
+    return arrays
+
+
+def consensus_metrics(evaluate, state, x_test, y_test,
+                      batch: int = 256) -> Dict[str, float]:
+    """Held-out metrics of the consensus mean: every worker row replaced
+    by the mean (``keepdims`` so the vmapped eval sees one pseudo-worker)
+    and the full test set covered in at most two compiled shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.tree_util.tree_map(
+        lambda a: a.mean(axis=0, keepdims=True), state.params)
+    stats = jax.tree_util.tree_map(
+        lambda a: a.mean(axis=0, keepdims=True), state.batch_stats)
+    losses, accs, weights = [], [], []
+    for i in range(0, len(x_test), batch):
+        xl = jnp.asarray(x_test[i:i + batch])
+        yl = jnp.asarray(y_test[i:i + batch])
+        l, a = evaluate(params, stats, xl, yl)
+        # graftcontract: sync — promotion-gate eval readback (promotion
+        # cadence only; the gate IS a host decision on these numbers)
+        losses.append(float(np.asarray(l)[0]))
+        # graftcontract: sync — second half of the same eval readback
+        accs.append(float(np.asarray(a)[0]))
+        weights.append(len(yl))
+    w = np.asarray(weights, np.float64)
+    return {
+        "test_loss": float((np.asarray(losses) * w).sum() / w.sum()),
+        "test_acc": float((np.asarray(accs) * w).sum() / w.sum()),
+    }
+
+
+def _sign(manifest: dict) -> str:
+    body = {k: v for k, v in manifest.items() if k != "signature"}
+    return hashlib.sha256(_canonical(body)).hexdigest()
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_json(path: str, obj: dict) -> None:
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=".manifest.", dir=directory)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def write_candidate(serving_dir: str, epoch: int, step: int,
+                    arrays: Dict[str, np.ndarray], metrics: Dict[str, float],
+                    fingerprint: str, journal_offset: int) -> dict:
+    """Write the candidate artifact + its signed manifest; returns the
+    manifest (NOT yet the serving pointer — ``decide_promotion`` is)."""
+    os.makedirs(serving_dir, exist_ok=True)
+    params_file = f"promoted-e{epoch:05d}.npz"
+    params_path = os.path.join(serving_dir, params_file)
+    fd, tmp = tempfile.mkstemp(prefix=".promoted.", dir=serving_dir)
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, params_path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "epoch": int(epoch),
+        "step": int(step),
+        "params_file": params_file,
+        "content_hash": _file_sha256(params_path),
+        "config_fingerprint": fingerprint,
+        "journal_offset": int(journal_offset),
+        "metrics": {k: float(v) for k, v in metrics.items()},
+    }
+    manifest["signature"] = _sign(manifest)
+    _atomic_json(os.path.join(serving_dir, f"manifest-e{epoch:05d}.json"),
+                 manifest)
+    return manifest
+
+
+def current_manifest(serving_dir: str) -> Optional[dict]:
+    path = os.path.join(serving_dir, MANIFEST_BASENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def decide_promotion(serving_dir: str, candidate: dict,
+                     margin: float = 0.0) -> Tuple[str, dict]:
+    """The promote/rollback state machine, one transition per cadence.
+
+    Returns ``(action, serving_manifest)`` where action is ``promote``
+    (pointer re-pointed to the candidate) or ``rollback`` (metric
+    regressed beyond ``margin`` vs the last promoted manifest: the
+    pointer keeps — i.e. re-points to — the previous promoted
+    checkpoint).  The pointer write is atomic either way: a reader sees
+    the old manifest or the new one, never a torn file.
+    """
+    previous = current_manifest(serving_dir)
+    pointer = os.path.join(serving_dir, MANIFEST_BASENAME)
+    if previous is not None:
+        prev_acc = float(previous.get("metrics", {}).get("test_acc", 0.0))
+        cand_acc = float(candidate.get("metrics", {}).get("test_acc", 0.0))
+        if cand_acc < prev_acc - float(margin):
+            # regression: the previous promoted manifest stays the
+            # serving truth (rewritten through the same atomic path so
+            # the decision leaves a fresh mtime audit trail)
+            _atomic_json(pointer, previous)
+            return "rollback", previous
+    _atomic_json(pointer, candidate)
+    return "promote", candidate
+
+
+def verify_promoted(serving_dir: str) -> dict:
+    """Verify the serving pointer end-to-end; raises PromotionTampered.
+
+    Checks, in order: pointer exists and parses; its signature matches
+    its own canonical content; the artifact it names exists; the
+    artifact's bytes hash to the manifest's ``content_hash``."""
+    manifest = current_manifest(serving_dir)
+    if manifest is None:
+        raise PromotionTampered(
+            f"no {MANIFEST_BASENAME} under {serving_dir} — nothing promoted")
+    if manifest.get("format") != MANIFEST_FORMAT:
+        raise PromotionTampered(
+            f"unknown manifest format {manifest.get('format')!r}")
+    if manifest.get("signature") != _sign(manifest):
+        raise PromotionTampered(
+            "manifest signature mismatch — the manifest was edited after "
+            "promotion")
+    params_path = os.path.join(serving_dir, manifest["params_file"])
+    if not os.path.exists(params_path):
+        raise PromotionTampered(
+            f"promoted artifact {manifest['params_file']} is missing")
+    digest = _file_sha256(params_path)
+    if digest != manifest["content_hash"]:
+        raise PromotionTampered(
+            f"promoted artifact hash mismatch: manifest says "
+            f"{manifest['content_hash'][:12]}…, file is {digest[:12]}…")
+    return manifest
+
+
+def prune_serving(serving_dir: str, keep: int = 3) -> List[str]:
+    """Retention: drop all but the newest ``keep`` candidates, never the
+    pointer's target.  Returns the basenames removed."""
+    pointer = current_manifest(serving_dir) or {}
+    pinned = pointer.get("params_file")
+    candidates = sorted(
+        f for f in os.listdir(serving_dir)
+        if f.startswith("promoted-e") and f.endswith(".npz"))
+    removed = []
+    for f in candidates[:-keep] if keep else candidates:
+        if f == pinned:
+            continue
+        os.unlink(os.path.join(serving_dir, f))
+        sidecar = f.replace("promoted-", "manifest-").replace(".npz", ".json")
+        if os.path.exists(os.path.join(serving_dir, sidecar)):
+            os.unlink(os.path.join(serving_dir, sidecar))
+        removed.append(f)
+    return removed

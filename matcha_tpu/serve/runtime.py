@@ -1,0 +1,65 @@
+"""Device-side control knobs (DESIGN.md §22).
+
+The run controller's entire influence over the compiled step is this
+small pytree riding ``TrainState.control`` — the same value-level seam
+elastic membership uses (``elastic.runtime.Membership``): the step
+multiplies the per-step flag row by ``row_scale * alpha_scale *
+local_gate``, so a budget re-solve, an α re-weight, or a local-SGD
+cadence change is a device *value* update and the program never
+recompiles (the zero-retrace contract).
+
+Identity knobs (all-ones ``row_scale``, ``alpha_scale`` 1, the config's
+``local_steps`` as ``local_every``) make a controller-supervised run
+numerically identical to an unsupervised one — the byte-identical
+crash-resume test rides on exactly this.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+__all__ = ["ControlKnobs", "control_arrays"]
+
+
+class ControlKnobs(struct.PyTreeNode):
+    """What the compiled step sees of the controller.
+
+    ``row_scale``: ``f32[M]`` per-matching activation re-weight — a
+    budget swap maps the re-solved probabilities onto the *committed*
+    flag stream as ``p_new[j] / p_old[j]`` (first-moment exact;
+    ``serve.control.resolve_budget_swap``).
+    ``alpha_scale``: ``f32[]`` scalar on the mixing weight, composing
+    with elastic/staleness scales exactly like theirs.
+    ``local_every``: ``i32[]`` gossip cadence — steps where
+    ``step % local_every != 0`` mix by identity (the traced twin of the
+    static ``local_steps`` flag-stream thinning).
+    """
+
+    row_scale: jax.Array
+    alpha_scale: jax.Array
+    local_every: jax.Array
+
+    @classmethod
+    def fresh(cls, num_matchings: int) -> "ControlKnobs":
+        """Identity knobs — the supervised run's default posture."""
+        return control_arrays(np.ones(num_matchings, np.float32), 1.0, 1)
+
+
+def control_arrays(row_scale, alpha_scale: float,
+                   local_every: int) -> ControlKnobs:
+    """Host → device image of the controller's knob state.
+
+    The same builder discipline as ``elastic.runtime.membership_arrays``:
+    the loop re-primes a fresh copy at every boundary so the epoch
+    program's input signature never varies.  Placement is the *caller's*
+    job (the loop replicates with ``NamedSharding(mesh, P())`` — the
+    ``[M]`` row axis must never be worker-sharded).
+    """
+    return ControlKnobs(
+        row_scale=jnp.asarray(np.asarray(row_scale, np.float32)),
+        alpha_scale=jnp.asarray(float(alpha_scale), jnp.float32),
+        local_every=jnp.asarray(max(int(local_every), 1), jnp.int32),
+    )

@@ -103,7 +103,11 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
     # arithmetic (loop.py's reconcile rebuilds them), and stripping keeps
     # checkpoint pytrees identical across every staleness setting — the
     # in-flight deltas themselves (mix_pending) are real state and stay.
-    state = state.replace(telemetry=(), membership=(), mix_ages=())
+    # control joins too (DESIGN.md §22): the run-controller's knob pytree
+    # is re-derivable from the journaled control events, and stripping it
+    # keeps checkpoints identical whether a controller supervises or not.
+    state = state.replace(telemetry=(), membership=(), mix_ages=(),
+                          control=())
     mgr = _manager(directory)
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
@@ -215,7 +219,9 @@ def restore_checkpoint(directory: str, template: TrainState,
     caller_telemetry = template.telemetry
     caller_membership = template.membership
     caller_mix_ages = template.mix_ages
-    template = template.replace(telemetry=(), membership=(), mix_ages=())
+    caller_control = template.control
+    template = template.replace(telemetry=(), membership=(), mix_ages=(),
+                                control=())
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
     try:
         state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
@@ -230,6 +236,9 @@ def restore_checkpoint(directory: str, template: TrainState,
         #   2. minus `mix_ages` and `membership` (PR7–PR8: has the
         #      telemetry slot, pre-elastic) — occupancy is sidecar state,
         #      never in the pytree;
+        #   0. minus `control` alone (PR13–PR16: pre-serve, every later
+        #      key present) — the controller's knobs are journal-
+        #      reconstructible either way;
         #   3. minus those and `telemetry` (PR4–PR6: has mix_pending,
         #      pre-obs);
         #   4. minus all four plus `mix_pending` (pre-PR4 legacy): a
@@ -242,9 +251,11 @@ def restore_checkpoint(directory: str, template: TrainState,
         fields = {f.name: getattr(abstract, f.name)
                   for f in dataclasses.fields(template)}
         state = None
-        for drop in (("mix_ages",), ("mix_ages", "membership"),
-                     ("mix_ages", "membership", "telemetry"),
-                     ("mix_ages", "membership", "telemetry", "mix_pending")):
+        for drop in (("control",), ("control", "mix_ages"),
+                     ("control", "mix_ages", "membership"),
+                     ("control", "mix_ages", "membership", "telemetry"),
+                     ("control", "mix_ages", "membership", "telemetry",
+                      "mix_pending")):
             older = {k: v for k, v in fields.items() if k not in drop}
             try:
                 restored = mgr.restore(
@@ -263,7 +274,8 @@ def restore_checkpoint(directory: str, template: TrainState,
             # names the real mismatch
     state = state.replace(telemetry=caller_telemetry,
                           membership=caller_membership,
-                          mix_ages=caller_mix_ages)
+                          mix_ages=caller_mix_ages,
+                          control=caller_control)
     mgr.close()
     if schedule is not None:
         cursor = int(np.asarray(state.step))

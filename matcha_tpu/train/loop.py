@@ -129,7 +129,15 @@ class TrainResult:
 
 
 # graftcontract: root
-def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
+def train(config: TrainConfig, resume_dir: Optional[str] = None,
+          boundary_hook=None) -> TrainResult:
+    # boundary_hook (DESIGN.md §22): the run controller's epoch-boundary
+    # seam — called with a `_BoundarySeam` handle before each epoch's
+    # membership/snapshot work.  Everything the hook can change is a
+    # device-VALUE update (ControlKnobs riding TrainState, host-side drift
+    # re-base, config fields the compiled program never traced), so a
+    # supervised run compiles exactly the programs an unsupervised one
+    # does — the zero-retrace contract extends to every hot-swap.
     if config.plan:
         # resolve the plan artifact's schedule choice (graph, budget, seed)
         # into the config before anything downstream reads those fields —
@@ -177,7 +185,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                                     schedule.num_matchings)
     run_flags = (np.asarray(schedule.flags, np.float32) * faults.link_up
                  if faults is not None else schedule.flags)
-    if config.local_steps > 1:
+    if config.local_steps > 1 and boundary_hook is None:
         # local SGD steps (DESIGN.md §20): gossip fires only every L-th
         # step.  Static thinning of the flag stream — an all-zero flag row
         # is identity mixing on every backend and moves zero wire bytes,
@@ -190,6 +198,9 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         # graftlint: disable=GL001 — thinning 0/1 plan weights on host
         # numpy, same shape algebra as the link_up fold above
         run_flags = np.asarray(run_flags, np.float32) * keep[:, None]
+        # (under a boundary_hook the static thinning is skipped: the
+        # controller's traced `local_every` knob subsumes it — initialized
+        # from config.local_steps below, hot-swappable at any boundary)
     # checkpoints always fingerprint the *as-built* schedule: recovery may
     # re-derive α (rebinding `schedule`), but no config could reproduce that
     # α at resume time — fingerprinting it would leave every post-recovery
@@ -197,6 +208,22 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
     # originally-solved α and re-derives again if faults recur; the flag
     # stream (what the cursor's meaning depends on) is identical either way.
     schedule0 = schedule
+
+    # run-controller knobs (DESIGN.md §22): host mirror of the
+    # serve.ControlKnobs pytree riding TrainState.control.  Identity
+    # values (all-ones row scale, unit α scale, local_every from config)
+    # make a supervised run numerically identical to an unsupervised one;
+    # a control-doc apply just rewrites these host values and re-primes
+    # the device copy at the next boundary — no program ever rebuilds.
+    control_knobs: Optional[Dict] = None
+    control_probs = None  # effective activation probs after a budget swap
+    stop_requested = False
+    if boundary_hook is not None:
+        control_knobs = {
+            "row_scale": np.ones(schedule.num_matchings, np.float32),
+            "alpha_scale": 1.0,
+            "local_every": max(int(config.local_steps), 1),
+        }
 
     # elastic membership (DESIGN.md §16): the trace replays at epoch
     # boundaries through a deterministic host controller; the device sees
@@ -364,6 +391,23 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                               elastic_ctl.alpha_scale)
         return shard_workers(m, mesh) if mesh is not None else m
 
+    def _fresh_control():
+        """Device image of the controller's knobs, rebuilt host-fresh at
+        every boundary with the ``_fresh_telemetry`` placement discipline.
+        Replicated — NOT ``shard_workers``: ``row_scale`` is ``[M]``
+        (matchings, not workers), so worker-axis sharding would be a shape
+        error on any real mesh."""
+        from ..serve.runtime import control_arrays
+
+        c = control_arrays(control_knobs["row_scale"],
+                           control_knobs["alpha_scale"],
+                           control_knobs["local_every"])
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            c = jax.device_put(c, NamedSharding(mesh, PartitionSpec()))
+        return c
+
     bootstrap_fn = None
     member_alive_np = None
     if elastic_ctl is not None:
@@ -398,6 +442,10 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         state = state.replace(membership=_fresh_membership())
     if mesh is not None:
         state = shard_workers(state, mesh)
+    if control_knobs is not None:
+        # after shard_workers: the [M] row_scale leaf must keep its
+        # replicated placement (worker-axis sharding would reject it)
+        state = state.replace(control=_fresh_control())
 
     def _make_step(comm):
         # reads `optimizer`, `lr_schedule`, `faults`, and `stale_scale` at
@@ -411,6 +459,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             overlap=config.overlap, staleness=config.staleness,
             stale_alpha_scale=stale_scale, telemetry=tel_spec,
             elastic=elastic_ctl is not None,
+            control=control_knobs is not None,
         )
 
     step_fn = None  # populated by _build_programs() below
@@ -539,7 +588,15 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
         if tel_spec is not None:
             state = state.replace(telemetry=_fresh_telemetry())
         if mesh is not None:  # reconcile may have created fresh zero rows
+            if control_knobs is not None:
+                # the setup path already primed the [M] knob leaf — drop
+                # it before the worker-axis re-shard would reject it
+                state = state.replace(control=())
             state = shard_workers(state, mesh)
+        if control_knobs is not None:
+            # checkpoints strip control (like telemetry); re-prime after
+            # the shard so the [M] leaf keeps its replicated placement
+            state = state.replace(control=_fresh_control())
 
     evaluate = make_eval_fn(model)
     recorder = Recorder(config, config.num_workers)
@@ -574,11 +631,19 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                       "participation": worker_stats["worker_participation"][i],
                       "disagreement": worker_stats["worker_disagreement"][i]}
                 for i, wid in enumerate(occupants) if wid is not None}
-    if start_epoch and config.save:
+    if config.save and (start_epoch or (
+            boundary_hook is not None
+            and os.path.exists(recorder.journal.path))):
         # re-align the CSV series with the restored epoch: reload the
         # previous run's rows truncated to the checkpoint, so save() extends
         # the history instead of overwriting it (or double-appending the
-        # replayed epochs on resume from an older checkpoint)
+        # replayed epochs on resume from an older checkpoint).  A
+        # *supervised* run reloads the journal even at start_epoch 0: a
+        # pre-first-checkpoint relaunch restarts training from scratch,
+        # but the journal is the supervision record — wiping the previous
+        # lifetime's control/promotion decisions would orphan the daemon's
+        # own audit trail (unsupervised reruns into a reused folder keep
+        # the historical rewrite semantics)
         recorder.load_previous(start_epoch)
     if fault_plan is not None:
         plan_events = fault_plan.to_json()["events"]
@@ -617,7 +682,12 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
             # the plan in force is the staleness-damped α: the executor
             # scales the flag row by stale_scale, so the monitor must
             # predict the contraction of the mixing that actually runs
-            schedule.laplacians(), schedule.probs, plan_alpha * stale_scale,
+            schedule.laplacians(),
+            # a controller budget swap re-weights the committed flag stream
+            # to new effective activation probabilities (first-moment exact;
+            # serve.control): the monitor must predict the mixing that runs
+            (schedule.probs if control_probs is None else control_probs),
+            plan_alpha * stale_scale,
             overlap=config.overlap, wire_dtype=config.wire_dtype,
             worker_alive=worker_alive,
             # graftcontract: sync — host fault-plan link expectation
@@ -712,8 +782,109 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None) -> TrainResult:
                 fingerprint=(cost_ledger.last_fingerprint(_step_label)
                              if cost_ledger is not None else None))
 
+    class _BoundarySeam:
+        """The run controller's handle into the loop (DESIGN.md §22).
+
+        Every mutator is a *value-level* change: knob updates ride the
+        ControlKnobs pytree, drift re-bases swap host floats, and config
+        edits touch only fields the compiled programs never traced — so
+        the retrace watch stays silent across any sequence of hot-swaps.
+        The controller side (serve.trainer.TrainerHarness) decides *what*
+        to apply; this seam only knows *how* without recompiling."""
+
+        def __init__(self):
+            self.epoch = 0
+            self.bpe = int(bpe)
+            self.recorder = recorder
+            self.schedule = schedule0
+            self.flattener = flattener
+            self.dataset = dataset
+            self.num_workers = config.num_workers
+
+        @property
+        def config(self):
+            return config
+
+        @property
+        def state(self):
+            return state
+
+        @property
+        def evaluate(self):
+            return evaluate
+
+        def set_control(self, row_scale=None, alpha_scale=None,
+                        local_every=None):
+            """Rewrite the host knob mirror; the loop top re-primes the
+            device copy before the epoch runs."""
+            if row_scale is not None:
+                control_knobs["row_scale"] = np.asarray(row_scale,
+                                                        np.float32)
+            if alpha_scale is not None:
+                control_knobs["alpha_scale"] = float(alpha_scale)
+            if local_every is not None:
+                control_knobs["local_every"] = max(int(local_every), 1)
+
+        def update_config(self, **fields):
+            """Replace untraced config fields (drift tolerance/patience,
+            local_steps bookkeeping, ...) — validated by TrainConfig's own
+            __post_init__ via dataclasses.replace."""
+            nonlocal config
+            config = dataclasses.replace(config, **fields)
+
+        def rebase_drift(self, alpha=None, probs=None):
+            """Re-base the drift monitor's plan after a budget swap: the
+            re-solved (α, p) IS the plan from here on — the same rule the
+            recovery and membership re-plans follow."""
+            nonlocal plan_alpha, predicted, drift_monitor, control_probs
+            if alpha is not None:
+                plan_alpha = float(alpha)
+            if probs is not None:
+                control_probs = np.asarray(probs, np.float64)
+            if drift_monitor is not None:
+                predicted = _compose_predicted()
+                drift_monitor = DriftMonitor(
+                    predicted["rho"], int(bpe),
+                    tolerance=config.drift_tolerance,
+                    patience=config.drift_patience)
+            return predicted
+
+        def checkpoint(self):
+            """Checkpoint the last *completed* epoch's state on demand
+            (pre-restart / pre-stop), reusing the cadence path's recipe."""
+            if self.epoch == 0:
+                return None  # nothing completed yet — nothing to save
+            path = f"{config.savePath}/{config.name}_ckpt"
+            with annotate("matcha/checkpoint"):
+                save_checkpoint(path, state, self.epoch - 1,
+                                schedule=schedule0,
+                                membership=_membership_sidecar())
+            recorder.log_event("checkpoint", epoch=self.epoch - 1,
+                               path=path)
+            return path
+
+        def request_stop(self):
+            """Stop cleanly before the next epoch: the loop breaks out to
+            the normal drain + final recorder flush."""
+            nonlocal stop_requested
+            stop_requested = True
+
+    seam = _BoundarySeam() if boundary_hook is not None else None
+
     epoch = start_epoch
     while epoch < config.epochs:
+        if boundary_hook is not None:
+            # the control plane's one entry point: apply pending control
+            # documents, run the promotion cadence, then re-prime the
+            # device knob image (fresh every boundary, like telemetry —
+            # one input placement signature whether or not it changed).
+            # A rollback retry re-enters this loop top: the hook must be
+            # idempotent per control-doc version (serve.trainer is).
+            seam.epoch = epoch
+            boundary_hook(seam)
+            if stop_requested:
+                break
+            state = state.replace(control=_fresh_control())
         if elastic_ctl is not None:
             # membership reconciliation — at this host boundary and nowhere
             # else (DESIGN.md §16).  advance() is idempotent per epoch, so

@@ -216,7 +216,13 @@ class Recorder:
                 if not os.path.exists(path):
                     complete = False
                     break
-                per_rank.append(np.loadtxt(path, delimiter=",", ndmin=1))
+                if os.path.getsize(path):
+                    per_rank.append(np.loadtxt(path, delimiter=",",
+                                               ndmin=1))
+                else:
+                    # a pre-first-epoch flush leaves zero-row CSVs;
+                    # loadtxt warns on them, an empty series is the fact
+                    per_rank.append(np.zeros(0))
             if not complete:
                 break
             n = min(epochs, min(len(s) for s in per_rank))

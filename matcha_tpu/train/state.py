@@ -83,6 +83,18 @@ class TrainState(struct.PyTreeNode):
     # (checkpoints carry a membership sidecar instead) and is stripped to
     # ``()`` around save/restore — checkpoint pytrees never change.
     membership: Any = ()
+    # run-controller knobs (DESIGN.md §22): a ``serve.ControlKnobs`` pytree
+    # (``row_scale: f32[M]`` per-matching activation re-weight,
+    # ``alpha_scale`` scalar, ``local_every`` i32 scalar gossip thinning)
+    # when a controller supervises the run, the empty tuple otherwise.  A
+    # *step input* exactly like membership: every hot-swap a control
+    # document asks for (budget re-solve, α re-derivation, local-step
+    # cadence) is a value update on these arrays at an epoch boundary —
+    # shapes never change, so the compiled epoch program survives every
+    # swap (the zero-retrace contract the §14 watch enforces).  Host-
+    # reconstructible from the journaled control events; stripped to ``()``
+    # around save/restore so checkpoint pytrees never change.
+    control: Any = ()
 
 
 def make_optimizer(
@@ -167,6 +179,7 @@ def make_train_step(
     stale_alpha_scale: float = 1.0,
     telemetry=None,
     elastic: bool = False,
+    control: bool = False,
 ):
     """Build ``step(state, xb, yb[, rng]) -> (state, metrics)``.
 
@@ -250,6 +263,18 @@ def make_train_step(
     join, leave, and rejoin never change a shape, which is the whole
     no-retrace contract the §14 watch enforces.  ``False`` (or an empty
     slot) compiles the exact pre-elastic program.
+
+    ``control``: when True *and* ``state.control`` is a real
+    ``serve.ControlKnobs`` pytree, the step multiplies the communicator's
+    flag row by the controller's runtime re-weighting (DESIGN.md §22):
+    ``row_scale[j]`` re-weights matching j's executed activation (a budget
+    hot-swap rides the committed flag stream by scaling each row to the
+    re-solved probabilities, first-moment-exact), ``alpha_scale`` executes
+    a re-derived α exactly (the same α·flag_j algebra elastic uses — the
+    two compose by multiplication), and ``local_every`` thins gossip to
+    every k-th step with an in-graph cursor gate.  All value updates at
+    epoch boundaries, shapes pinned — the zero-retrace contract.  ``False``
+    (or an empty slot) compiles the exact pre-serve program.
     """
     flags_arr = jnp.asarray(np.asarray(flags), jnp.float32)  # [T, M]
     n_workers = flattener.num_workers
@@ -350,6 +375,19 @@ def make_train_step(
         if elastic and not isinstance(state.membership, tuple):
             member = state.membership.alive
             comm_flags_t = comm_flags_arr[t] * state.membership.alpha_scale
+        # run-controller knobs (DESIGN.md §22): pure multiplicative
+        # re-weighting of the flag row — per-matching row_scale (budget
+        # re-solve), α′/α (mixing-weight re-derivation), and the
+        # local-step gate (gossip every k-th step).  Composes with the
+        # elastic α scale above; shapes never change, so every hot-swap
+        # reuses this compiled program verbatim.
+        if control and not isinstance(state.control, tuple):
+            knobs = state.control
+            local_gate = (jax.lax.rem(
+                state.step, jnp.maximum(knobs.local_every, 1)) == 0
+            ).astype(jnp.float32)
+            comm_flags_t = (comm_flags_t * knobs.row_scale
+                            * knobs.alpha_scale * local_gate)
         alive = None
         if faults is not None or member is not None:
             from ..resilience.runtime import (

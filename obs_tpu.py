@@ -449,17 +449,23 @@ def cmd_timeline(args) -> int:
 def cmd_watch(args) -> int:
     import time
 
-    from matcha_tpu.obs.health import fleet_status, render_watch
+    from matcha_tpu.obs.health import fleet_verdict, render_watch
 
     def once() -> int:
-        status = fleet_status(args.run, deadline=args.deadline,
-                              tail=args.tail)
+        # the 0/1/2 exit contract lives in fleet_verdict, shared verbatim
+        # with the serve plane's /healthz endpoint (parity pinned by test)
+        rc, status = fleet_verdict(args.run, deadline=args.deadline,
+                                   tail=args.tail)
+        if status is None:
+            print(f"obs_tpu: no heartbeat evidence under {args.run}",
+                  file=sys.stderr)
+            return rc
         print(render_watch(status))
         if args.md:
             with open(args.md, "w") as f:
                 f.write(render_watch(status, markdown=True))
             print(f"# markdown written to {args.md}", file=sys.stderr)
-        return 1 if status["flagged"] else 0
+        return rc
 
     if args.once:
         return once()

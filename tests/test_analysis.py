@@ -36,7 +36,8 @@ from matcha_tpu.analysis.engine import load_source
 pytestmark = pytest.mark.analysis
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-LINT_TARGETS = ["matcha_tpu", "train_tpu.py", "plan_tpu.py", "bench.py"]
+LINT_TARGETS = ["matcha_tpu", "train_tpu.py", "plan_tpu.py", "bench.py",
+                "serve_tpu.py"]
 
 
 def _lint(tmp_path, code, rules=None, filename="snippet.py"):

@@ -35,7 +35,7 @@ pytestmark = pytest.mark.contracts
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
 LINT_TARGETS = ["matcha_tpu", "train_tpu.py", "plan_tpu.py", "bench.py",
-                "obs_tpu.py"]
+                "obs_tpu.py", "serve_tpu.py"]
 
 
 def _src(tmp_path, code, filename="snippet.py"):
@@ -412,7 +412,9 @@ def test_gl202_registry_extraction_folds_the_real_registry():
         ast.parse((REPO / "matcha_tpu/obs/journal.py").read_text()))
     assert reg["SCHEMA_VERSION"] == max(reg["ACCEPTED_VERSIONS"])
     assert "backend" in reg["EVENT_KINDS"]
-    assert reg["KIND_MIN_VERSION"]["backend"] == reg["SCHEMA_VERSION"]
+    assert reg["KIND_MIN_VERSION"]["backend"] == 5
+    assert reg["KIND_MIN_VERSION"]["control"] == reg["SCHEMA_VERSION"]
+    assert reg["KIND_MIN_VERSION"]["promotion"] == reg["SCHEMA_VERSION"]
     assert set(reg["REQUIRED_FIELDS"]) <= set(reg["EVENT_KINDS"])
 
 
@@ -436,7 +438,7 @@ def test_gl202_new_kind_without_min_version_fires(tmp_path):
 
 def test_gl202_min_version_beyond_schema_version_fires(tmp_path):
     src = _tampered_journal(
-        tmp_path, '**{k: 5 for k in V5_KINDS}}', '**{k: 6 for k in V5_KINDS}}')
+        tmp_path, '**{k: 6 for k in V6_KINDS}}', '**{k: 7 for k in V6_KINDS}}')
     vs = lint_source(src, list(CONTRACT_RULES))
     assert any("SCHEMA_VERSION" in v.message and v.rule == "GL202"
                for v in vs)
@@ -444,13 +446,13 @@ def test_gl202_min_version_beyond_schema_version_fires(tmp_path):
 
 def test_gl202_version_bump_without_a_new_kind_fires(tmp_path):
     src = _tampered_journal(
-        tmp_path, "SCHEMA_VERSION = 5\nACCEPTED_VERSIONS = "
-                  "frozenset({1, 2, 3, 4, 5})",
-        "SCHEMA_VERSION = 6\nACCEPTED_VERSIONS = "
-        "frozenset({1, 2, 3, 4, 5, 6})")
+        tmp_path, "SCHEMA_VERSION = 6\nACCEPTED_VERSIONS = "
+                  "frozenset({1, 2, 3, 4, 5, 6})",
+        "SCHEMA_VERSION = 7\nACCEPTED_VERSIONS = "
+        "frozenset({1, 2, 3, 4, 5, 6, 7})")
     vs = lint_source(src, list(CONTRACT_RULES))
     assert _ids(vs) == ["GL202"]
-    assert "no kind is introduced at v6" in vs[0].message
+    assert "no kind is introduced at v7" in vs[0].message
 
 
 # ===================================================================== GL203
@@ -559,13 +561,13 @@ def test_gl203_tamper_real_checkpoint_ladder(tmp_path):
     generation from a copy of train/checkpoint.py — exactly GL203 fires,
     naming the field."""
     text = (REPO / "matcha_tpu/train/checkpoint.py").read_text()
-    anchor = '("mix_ages", "membership", "telemetry", "mix_pending")'
+    anchor = ('"telemetry",\n'
+              '                      "mix_pending")')
     assert anchor in text, "tamper anchor rotted"
     (tmp_path / "state.py").write_text(
         (REPO / "matcha_tpu/train/state.py").read_text())
     f = tmp_path / "checkpoint.py"
-    f.write_text(text.replace(
-        anchor, '("mix_ages", "membership", "telemetry")'))
+    f.write_text(text.replace(anchor, '"telemetry")'))
     vs = lint_source(load_source(f, REPO), list(CONTRACT_RULES))
     assert _ids(vs) == ["GL203"]
     assert "`mix_pending`" in vs[0].message

@@ -154,7 +154,7 @@ def test_imagenet_resnet18_layout_and_registry():
 
 # resnet8 pins the remat-identity property in tier-1; the VGG/WRN liftings
 # re-prove the same property on ~10× the compute (≈45 s each on the CPU test
-# mesh), so they ride the slow lane — the tier-1 budget (870 s) was already
+# mesh), so they ride the slow lane — the tier-1 budget (1500 s) was already
 # at its ceiling at the seed, and these two were the single largest line item
 @pytest.mark.parametrize("name", [
     pytest.param("vgg11", marks=pytest.mark.slow),

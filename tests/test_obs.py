@@ -178,15 +178,25 @@ def test_reference_journal_validates_line_by_line():
     ISSUE 11 re-pins at v4 with the attribution plane: the regeneration
     script appends one `attribution` event from a planted heterogeneous-
     link scenario (matching 1 priced 3x matching 0), so the estimator's
-    recovered per-matching seconds are committed evidence too."""
+    recovered per-matching seconds are committed evidence too.  ISSUE 17
+    re-pins at v6 with the serve plane riding the same run through the
+    REAL TrainerHarness: one `backend` selection record (the v5 kind,
+    journaled since ISSUE 13 but first committed here), one `promotion`
+    (the consensus mean promoted at epoch 4, mid-churn), and one applied
+    `control` hot-swap (budget 0.5 -> 0.35 at the epoch-6 boundary, after
+    the rejoin re-fold) carrying the re-based drift prediction — which is
+    exactly what keeps `obs_tpu drift` exit 0 on this journal
+    (test_cli_drift_exit_codes): the replay re-bases at the swap like the
+    live monitor did."""
     events = read_journal(str(REPO / "benchmarks" / "events_ring8.jsonl"))
     assert events, "reference journal is empty"
     for i, e in enumerate(events):
         assert validate_event(e) == [], f"line {i + 1}: {validate_event(e)}"
-    assert {e["v"] for e in events} == {4}
+    assert {e["v"] for e in events} == {6}
     kinds = {e["kind"] for e in events}
     assert {"run_start", "epoch", "telemetry", "compile",
-            "membership", "heartbeat", "anomaly", "attribution"} <= kinds
+            "membership", "heartbeat", "anomaly", "attribution",
+            "backend", "control", "promotion"} <= kinds
     leave, rejoin = [e for e in events if e["kind"] == "membership"]
     assert (leave["epoch"], rejoin["epoch"]) == (2, 5)
     assert [t["kind"] for t in leave["trigger"]] == ["leave"]
@@ -243,6 +253,22 @@ def test_reference_journal_validates_line_by_line():
     assert theta[0] == pytest.approx(0.02, rel=1e-3)
     assert theta[1] == pytest.approx(0.06, rel=1e-3)
     assert attr["base_seconds"] == pytest.approx(0.01, rel=1e-3)
+    # v6 serve plane: one applied hot-swap through the real value path
+    # (re-solved row scaling, re-based prediction riding the event) and
+    # one promotion decision with its gating held-out metric — and the
+    # zero-retrace contract holds on the committed run itself
+    [swap] = [e for e in events if e["kind"] == "control"]
+    assert (swap["action"], swap["applied"], swap["epoch"]) \
+        == ("apply", True, 6)
+    assert swap["version"] == 1
+    assert swap["fields"]["budget"]["budget"] == pytest.approx(0.35)
+    assert len(swap["fields"]["budget"]["row_scale"]) == 2  # per-matching
+    assert 0.0 < swap["predicted"]["rho"] < 1.0
+    [promo] = [e for e in events if e["kind"] == "promotion"]
+    assert (promo["action"], promo["epoch"], promo["serving_epoch"]) \
+        == ("promote", 4, 4)
+    assert 0.0 <= promo["metric"] <= 1.0 and len(promo["content_hash"]) == 16
+    assert not [e for e in events if e["kind"] == "retrace"]
 
 
 def test_validate_event_rejects_drift():
@@ -348,6 +374,73 @@ def test_v4_kinds_are_versioned_and_v3_events_validate_verbatim():
     v3 = {"v": 3, "kind": "anomaly", "t": 1.0, "epoch": 0, "subject": "w5",
           "cause": "straggler", "value": 0.25, "threshold": 0.9}
     assert validate_event(v3) == []
+
+
+def test_v6_kinds_are_versioned_and_v1_to_v5_validate_verbatim(tmp_path):
+    """The v5→v6 bump (ISSUE 17) is additive the same way: one sample
+    event per pre-bump version (v1 resume, v2 membership, v3 heartbeat,
+    v4 attribution, v5 backend) validates verbatim under the v6 reader
+    AND round-trips byte-identically through the journal writer — both
+    directions of compatibility.  A `control` / `promotion` event
+    claiming v<=5 is a lying envelope."""
+    from matcha_tpu.obs.journal import (
+        EVENT_KINDS,
+        KIND_MIN_VERSION,
+        V5_KINDS,
+        V6_KINDS,
+    )
+
+    assert V5_KINDS == {"backend"}
+    assert V6_KINDS == {"control", "promotion"}
+    assert V6_KINDS <= EVENT_KINDS
+    control = {"v": 6, "kind": "control", "t": 1.0, "epoch": 3,
+               "action": "apply", "applied": True, "version": 2,
+               "reason": "value-scope fields ['budget']",
+               "fields": {"budget": {"budget": 0.25}}}
+    promotion = {"v": 6, "kind": "promotion", "t": 1.0, "epoch": 4,
+                 "action": "rollback", "metric": 0.61, "test_loss": 1.2,
+                 "serving_epoch": 2, "content_hash": "ab" * 8}
+    for event in (control, promotion):
+        assert KIND_MIN_VERSION[event["kind"]] == 6
+        assert validate_event(event) == []
+        for v in (1, 2, 3, 4, 5):
+            assert any("v6 kind" in p
+                       for p in validate_event({**event, "v": v}))
+    assert any("missing" in p for p in validate_event(
+        {k: v for k, v in control.items() if k != "applied"}))
+    assert any("missing" in p for p in validate_event(
+        {k: v for k, v in promotion.items() if k != "metric"}))
+    # one pre-bump writer per version, verbatim-valid both directions:
+    # the v6 reader accepts each, and the journal writer round-trips the
+    # exact lines (a v6 writer never rewrites history it appends after)
+    pre_bump = [
+        {"v": 1, "kind": "resume", "t": 0.5, "epoch": 3},
+        {"v": 2, "kind": "membership", "t": 1.0, "epoch": 2,
+         "old_alive": [1.0, 1.0], "new_alive": [1.0, 0.0],
+         "trigger": [{"kind": "leave", "epoch": 2, "worker": "w1"}],
+         "alpha": 0.5, "rho": 0.9, "replanned": True},
+        {"v": 3, "kind": "heartbeat", "t": 1.0, "host": "host0",
+         "epoch": 0, "step": 4, "step_time": 0.1, "step_time_ewma": 0.1,
+         "comp_time": 0.3, "comm_time": 0.1, "peak_bytes": None,
+         "workers": {"w0": {"slot": 0, "participation": 1.0,
+                            "disagreement": 0.01}}},
+        {"v": 4, "kind": "attribution", "t": 1.0, "epochs_used": 8,
+         "matchings": 2, "identifiable": [True, True],
+         "base_seconds": 0.01, "per_matching_seconds": [0.02, 0.06],
+         "source": "journal:epoch.comm_time"},
+        {"v": 5, "kind": "backend", "t": 1.0, "requested": "auto",
+         "chosen": "fused", "reason": "measured within gate"},
+    ]
+    path = tmp_path / "pre_bump.jsonl"
+    with open(path, "w") as f:
+        for e in pre_bump:
+            assert validate_event(e) == [], e["kind"]
+            f.write(json.dumps(e) + "\n")
+    before = path.read_bytes()
+    append_journal_record(str(path), "control", epoch=1, action="stop",
+                          applied=True, reason="operator stop document")
+    assert read_journal(str(path))[:-1] == pre_bump  # grown, not rewritten
+    assert path.read_bytes().startswith(before)
 
 
 def test_read_journal_tail_is_bounded_and_exact(tmp_path):
