@@ -36,11 +36,18 @@ published at the epoch-6 boundary — after the rejoin re-fold, so the
 membership pins stay untouched — applied as a value update with zero
 retraces, carrying the re-based drift prediction for replay parity.
 
+The v7 recovery plane (ISSUE 18) rides along too: the run checkpoints
+every epoch (``checkpoint`` events, digest sidecars), and post-run the
+newest generation is bit-flipped, convicted by its digest sidecar, and
+quarantined — all through the REAL ladder helpers — with the resulting
+``recovery`` event appended the way a resuming run journals it.
+
 Regenerate after a journal schema bump (the v1→v2 bump of ISSUE 8 added
 ``compile`` events from the cost ledger; ISSUE 9 added ``membership``;
 the v2→v3 bump of ISSUE 10 added ``heartbeat`` and ``anomaly``; the
 v3→v4 bump of ISSUE 11 added ``attribution``; the v5→v6 bump of
-ISSUE 17 added ``control`` and ``promotion``):
+ISSUE 17 added ``control`` and ``promotion``; the v6→v7 bump of
+ISSUE 18 added ``recovery``):
 
     JAX_PLATFORMS=cpu python benchmarks/make_reference_journal.py
 """
@@ -79,7 +86,7 @@ def main() -> int:
         num_workers=8, graphid=5, batch_size=8, epochs=8, lr=0.0,
         warmup=False, momentum=0.0, weight_decay=0.0, matcha=True,
         budget=0.5, seed=3, save=True, sync_init=False, eval_every=0,
-        measure_comm_split=False,
+        checkpoint_every=1, measure_comm_split=False,
         membership_trace={"name": "ref_churn", "events": [
             {"kind": "leave", "epoch": 2, "worker": "w3"},
             {"kind": "rejoin", "epoch": 5, "worker": "w3"},
@@ -170,6 +177,34 @@ def main() -> int:
     with open(costs_path, "w") as f:
         json.dump(artifact, f, indent=1, sort_keys=True)
         f.write("\n")
+
+    # v7 pin: the recovery ladder through the REAL helpers — flip one bit
+    # in the newest checkpoint generation, let the digest sidecar convict
+    # it, quarantine it aside, and journal the move exactly the way a
+    # resuming run does (never a hand-written dict)
+    import random
+
+    from matcha_tpu.chaos.injectors import bitflip_checkpoint
+    from matcha_tpu.train.checkpoint import (
+        latest_step,
+        quarantine_step,
+        verify_checkpoint_digest,
+    )
+
+    ckpt = os.path.join(root, "runs", "ring8_ckpt")
+    step = latest_step(ckpt)
+    assert step == cfg.epochs - 1, step
+    assert verify_checkpoint_digest(ckpt, step) == []
+    bitflip_checkpoint(ckpt, step, random.Random(0))
+    problems = verify_checkpoint_digest(ckpt, step)
+    assert problems, "the digest sidecar must convict the flipped bit"
+    qdir = quarantine_step(ckpt, step)
+    assert latest_step(ckpt) == step - 1  # the ladder's next rung
+    append_journal_record(
+        dst, "recovery", scope="checkpoint", action="quarantine",
+        reason=f"digest verification failed: {problems[0]}", epoch=step,
+        quarantined=os.path.join("runs", "ring8_ckpt",
+                                 os.path.basename(qdir)))
     print(f"reference journal regenerated: {dst}")
     print(f"reference link costs regenerated: {costs_path}")
     return 0

@@ -184,6 +184,23 @@ timeout -k 30 600 python benchmarks/serve_probe.py --round 8 \
     --out benchmarks/serve_r8.md \
     || echo "serve_r8: controller probe failed (see benchmarks/serve_r8.md)"
 
+# 1.96 chaos_r8 (ISSUE 18: the host-plane chaos campaign's committable
+#      verdict).  26 seeded trials — two full rotations through every
+#      injector family (checkpoint corruption, torn/corrupt journal,
+#      torn control publish, kills at the four seeded barriers, ENOSPC /
+#      hung heartbeat IO, clock skew) — against the real supervised
+#      daemon, judged by the pinned invariant suite.  The faults all
+#      live on the host/storage plane, so the verdict is
+#      accelerator-independent; running it inside the TPU window pins
+#      that the recovery ladder behaves identically when orbax holds
+#      device arrays.  Failing seeds print in the artifact with their
+#      exact replay command.
+rm -rf benchmarks/chaos_run_r8
+timeout -k 30 1800 python chaos_tpu.py campaign --trials 26 \
+    --workdir benchmarks/chaos_run_r8 --md benchmarks/chaos_r8.md \
+    || echo "chaos_r8: campaign FAILED (see benchmarks/chaos_r8.md)"
+rm -rf benchmarks/chaos_run_r8
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist

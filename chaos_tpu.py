@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Chaos CLI — seeded host-plane fault campaigns (DESIGN.md §23).
+
+Runs deterministic fault campaigns against the real serve daemon (the
+same ``Controller`` → ``matcha_tpu.serve.trainer`` subprocess stack
+``serve_tpu.py run`` drives) and judges every trial with the pinned
+invariant suite.  CPU-only by design: every injector targets the
+host/storage plane (checkpoints, journal, control.json, heartbeat
+files), which is identical on a laptop and a pod.
+
+Commands
+--------
+``campaign [--trials N] [--seed0 K] [--workdir DIR] [--md PATH]``
+    Run N seeded trials (seeds K..K+N-1 → injector families round-robin
+    via ``seed % len(FAMILIES)``).  ``--md`` writes the report artifact
+    (the ``chaos_r8.md`` shape).  Exit 1 when any trial fails.
+
+``replay --seed S [--workdir DIR]``
+    Re-run one seed's exact fault schedule (the determinism contract:
+    same seed, same schedule, same verdict).  Exit mirrors the verdict.
+
+``shrink --seed S [--workdir DIR]``
+    Greedily minimize a FAILING seed's fault schedule: every spec
+    parameter is walked back toward its default while the trial still
+    fails; prints the minimal reproducing spec as JSON.
+
+``families``
+    List the injector families and which seeds (mod) land on each.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# the trainer subprocesses are CPU work; never grab a device by accident
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _log(msg: str) -> None:
+    print(msg, flush=True)
+
+
+def cmd_campaign(args) -> int:
+    from matcha_tpu.chaos import run_campaign
+    from matcha_tpu.chaos.campaign import render_report
+
+    seeds = range(args.seed0, args.seed0 + args.trials)
+    campaign = run_campaign(seeds, args.workdir, log=_log)
+    report = render_report(campaign)
+    if args.md:
+        os.makedirs(os.path.dirname(os.path.abspath(args.md)),
+                    exist_ok=True)
+        with open(args.md, "w") as f:
+            f.write(report)
+        _log(f"chaos: report written to {args.md}")
+    print(report)
+    return 0 if campaign["ok"] else 1
+
+
+def cmd_replay(args) -> int:
+    from matcha_tpu.chaos import run_trial, schedule_for_seed
+
+    spec = schedule_for_seed(args.seed)
+    _log(f"chaos: replaying seed {args.seed}: {json.dumps(spec.to_json())}")
+    trial = run_trial(spec, args.workdir, log=_log)
+    print(json.dumps({k: trial[k] for k in
+                      ("seed", "family", "rc", "restarts_used",
+                       "lifetimes", "ok", "violations")}, indent=2))
+    return 0 if trial["ok"] else 1
+
+
+def cmd_shrink(args) -> int:
+    from matcha_tpu.chaos import schedule_for_seed, shrink
+
+    spec = schedule_for_seed(args.seed)
+    minimal = shrink(spec, args.workdir, log=_log)
+    print(json.dumps(minimal.to_json(), indent=2))
+    return 0
+
+
+def cmd_families(args) -> int:
+    from matcha_tpu.chaos import FAMILIES
+
+    for i, family in enumerate(FAMILIES):
+        print(f"seed % {len(FAMILIES)} == {i:2d} → {family}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("campaign", help="run N seeded trials")
+    s.add_argument("--trials", type=int, default=26)
+    s.add_argument("--seed0", type=int, default=0)
+    s.add_argument("--workdir", default="runs/chaos")
+    s.add_argument("--md", default=None, help="write the report artifact")
+    s.set_defaults(fn=cmd_campaign)
+
+    s = sub.add_parser("replay", help="re-run one seed exactly")
+    s.add_argument("--seed", type=int, required=True)
+    s.add_argument("--workdir", default="runs/chaos")
+    s.set_defaults(fn=cmd_replay)
+
+    s = sub.add_parser("shrink", help="minimize a failing seed's schedule")
+    s.add_argument("--seed", type=int, required=True)
+    s.add_argument("--workdir", default="runs/chaos")
+    s.set_defaults(fn=cmd_shrink)
+
+    s = sub.add_parser("families", help="list injector families")
+    s.set_defaults(fn=cmd_families)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -311,4 +311,20 @@ python serve_tpu.py verify "$SERVE_DIR/servesmoke_serving" \
     >/dev/null || rc=1
 rm -rf "$SERVE_DIR"
 
+echo "== chaos pytest lane (fast units) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m 'chaos and not slow' -p no:cacheprovider || rc=1
+
+echo "== chaos smoke (corrupt-latest + kill-mid-save trials) =="
+# seed 0 = ckpt_bitflip (the ladder must recover from an older
+# generation charging zero restarts), seed 7 = kill_mid_save (resume
+# must match the uninterrupted twin exactly); replay exits non-zero
+# when any invariant is violated
+CHAOS_DIR="$(mktemp -d)"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python chaos_tpu.py replay \
+    --seed 0 --workdir "$CHAOS_DIR" >/dev/null || rc=1
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python chaos_tpu.py replay \
+    --seed 7 --workdir "$CHAOS_DIR" >/dev/null || rc=1
+rm -rf "$CHAOS_DIR"
+
 exit $rc

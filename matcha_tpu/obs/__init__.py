@@ -47,6 +47,16 @@ And the *attribution plane* (DESIGN.md §18, ISSUE 11):
   merged into one Chrome-trace/Perfetto ``trace_event`` JSON (one track
   per host), schema-validated and round-trip-checked.
 
+And the *durable-state recovery* half (DESIGN.md §23, ISSUE 18):
+
+* :mod:`bestio` — the fs seam every observability write rides (the chaos
+  harness injects ENOSPC/hung IO under it), the skew-aware ``wall_clock``,
+  and ``BestEffortSink``: bounded retry + deadline + breaker, so training
+  never blocks or dies on telemetry IO and degradation stays loud.
+* :func:`journal.salvage_journal` — salvage-prefix-and-quarantine for a
+  journal corrupted mid-stream (``read_journal(repair=True)`` forgives
+  only the crash-truncated tail).
+
 ``obs_tpu.py`` renders a run's journal (summary / tail / drift / compare),
 the performance artifacts (roofline / capacity / profile), the live
 fleet status (watch / health), and the attribution plane (attribute /
@@ -76,6 +86,7 @@ from .health import (
     read_heartbeats,
     render_watch,
 )
+from .bestio import BestEffortSink, get_fs, install_fs, wall_clock
 from .journal import (
     EVENT_KINDS,
     FAULT_KINDS,
@@ -87,6 +98,7 @@ from .journal import (
     read_journal,
     read_journal_tail,
     resolve_journal_path,
+    salvage_journal,
     validate_event,
 )
 from .telemetry import Telemetry, TelemetrySpec, telemetry_flush, telemetry_step
@@ -96,6 +108,7 @@ from .xprof import TraceParseError, overlap_report, profile_report
 __all__ = [
     "ANOMALY_CAUSES",
     "AnomalyDetector",
+    "BestEffortSink",
     "CostLedger",
     "DriftMonitor",
     "EVENT_KINDS",
@@ -119,6 +132,8 @@ __all__ = [
     "critical_path_report",
     "drift_report",
     "epoch_series",
+    "get_fs",
+    "install_fs",
     "link_costs_artifact",
     "mad_zscores",
     "make_event",
@@ -131,9 +146,11 @@ __all__ = [
     "render_watch",
     "resolve_journal_path",
     "roofline_report",
+    "salvage_journal",
     "telemetry_flush",
     "telemetry_step",
     "timeline_for_run",
     "validate_event",
     "validate_trace",
+    "wall_clock",
 ]

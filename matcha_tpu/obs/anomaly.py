@@ -46,6 +46,7 @@ ANOMALY_CAUSES = (
     "step_time_spike",       # host step-time >> its own history
     "comm_time_spike",       # host comm-time >> its own history
     "deadline_missed",       # no heartbeat within the liveness deadline
+    "telemetry_degraded",    # an observability sink is dropping writes
 )
 
 #: MAD → σ under normality; the conventional robust-z consistency constant.

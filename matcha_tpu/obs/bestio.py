@@ -1,0 +1,230 @@
+"""Best-effort observability IO: the fault-injectable fs seam + breaker.
+
+DESIGN.md §23's best-effort-IO contract: **training never blocks or dies
+on telemetry IO**.  Two pieces enforce it:
+
+* The **fs seam** — every observability write (the run journal, the
+  per-host heartbeat files, the Recorder's CSVs/sidecars) opens and
+  publishes files through :func:`get_fs` instead of the builtins.  In a
+  real run that is :class:`DirectFS` (zero-cost passthrough).  The chaos
+  harness threads :class:`FaultyFS` under the same seam — via
+  ``install_fs`` in-process, or the ``MATCHA_CHAOS_FS`` environment
+  variable across the supervisor's process boundary — to inject ENOSPC
+  and hung/slow writes into the *real* daemon without patching it.
+
+* The **sink breaker** — :class:`BestEffortSink` wraps one observability
+  write path in bounded retry + backoff with a per-attempt deadline.  A
+  write that fails (ENOSPC) retries within the deadline and then trips
+  the breaker: subsequent writes are *dropped* for a cooldown window
+  instead of retried inline.  A write that hangs is abandoned to its
+  daemon thread (the sink skips fast while it is stuck) — the train loop
+  stalls at most one deadline, ever.  Every degrade/restore transition
+  is reported through :meth:`BestEffortSink.drain` as a ``recovery``
+  journal payload (scope ``io``), queued in memory so it reaches disk on
+  the next write that *does* succeed — the run journal is how a degraded
+  sink stays loud instead of lying.
+
+``wall_clock`` is the one clock heartbeat emitters stamp: identical to
+``time.time()`` in a real run, skewed by ``MATCHA_CHAOS_CLOCK_SKEW``
+seconds under the chaos harness (the clock-skew injector).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ENV_FS", "ENV_SKEW", "DirectFS", "FaultyFS", "get_fs",
+           "install_fs", "wall_clock", "BestEffortSink"]
+
+ENV_FS = "MATCHA_CHAOS_FS"
+ENV_SKEW = "MATCHA_CHAOS_CLOCK_SKEW"
+
+
+class DirectFS:
+    """The production seam: builtins, nothing else."""
+
+    def open(self, path: str, mode: str = "r"):
+        return open(path, mode)
+
+    def replace(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+
+class FaultyFS(DirectFS):
+    """A seam that faults a window of matching operations.
+
+    ``mode``: ``enospc`` raises ``OSError(ENOSPC)``; ``slow`` sleeps
+    ``delay`` seconds per op (a hung sink is a slow one with a delay
+    longer than anyone waits).  ``match`` is a path substring gate
+    (``"health/"`` targets heartbeat files only); ``after`` matching ops
+    pass clean before the window opens; ``count`` ops fault before the
+    device heals (``-1`` = never heals).
+    """
+
+    def __init__(self, mode: str = "enospc", match: str = "",
+                 after: int = 0, count: int = -1, delay: float = 0.0):
+        if mode not in ("enospc", "slow"):
+            raise ValueError(f"unknown FaultyFS mode {mode!r}")
+        self.mode = mode
+        self.match = str(match)
+        self.after = int(after)
+        self.count = int(count)
+        self.delay = float(delay)
+        self.ops = 0  # matching ops seen
+
+    def _trip(self, path: str) -> None:
+        if self.match and self.match not in str(path):
+            return
+        self.ops += 1
+        n = self.ops - self.after  # 1-based position inside the window
+        if n <= 0 or (self.count >= 0 and n > self.count):
+            return
+        if self.mode == "slow":
+            time.sleep(self.delay)
+            return
+        raise OSError(errno.ENOSPC, f"chaos: no space left on device "
+                                    f"(injected, op {self.ops})", path)
+
+    def open(self, path: str, mode: str = "r"):
+        if "w" in mode or "a" in mode or "x" in mode or "+" in mode:
+            self._trip(path)
+        return super().open(path, mode)
+
+    def replace(self, src: str, dst: str) -> None:
+        self._trip(dst)
+        super().replace(src, dst)
+
+
+_fs: Optional[DirectFS] = None
+
+
+def get_fs() -> DirectFS:
+    """The active fs seam — ``DirectFS`` unless chaos installed a faulty
+    one (``install_fs``) or armed ``MATCHA_CHAOS_FS`` before this
+    process imported us (the supervisor→trainer injection path)."""
+    global _fs
+    if _fs is None:
+        raw = os.environ.get(ENV_FS)
+        if raw:
+            try:
+                _fs = FaultyFS(**json.loads(raw))
+            except (ValueError, TypeError):
+                _fs = DirectFS()  # malformed spec must not break a run
+        else:
+            _fs = DirectFS()
+    return _fs
+
+
+def install_fs(fs: Optional[DirectFS]) -> None:
+    """Swap the seam in-process (chaos harness / tests); ``None`` re-reads
+    the environment on next use."""
+    global _fs
+    _fs = fs
+
+
+def wall_clock() -> float:
+    """``time.time()`` plus the injected skew (0 in a real run)."""
+    try:
+        skew = float(os.environ.get(ENV_SKEW) or 0.0)
+    except ValueError:
+        skew = 0.0
+    return max(time.time() + skew, 0.0)
+
+
+class BestEffortSink:
+    """Bounded-retry, deadline-capped, breaker-guarded write wrapper.
+
+    :meth:`write` never raises and never blocks longer than
+    ``(retries + 1) * deadline`` plus the backoff sleeps; once degraded it
+    returns immediately (dropping the write) until ``cooldown`` elapses or
+    a probe write succeeds.  Degrade/restore transitions accumulate as
+    ``recovery``-event payloads; callers drain and journal them.
+    """
+
+    def __init__(self, name: str, deadline: float = 5.0, retries: int = 1,
+                 backoff: float = 0.1, cooldown: float = 30.0):
+        self.name = str(name)
+        self.deadline = float(deadline)
+        self.retries = max(int(retries), 0)
+        self.backoff = float(backoff)
+        self.cooldown = float(cooldown)
+        self.degraded = False
+        self.dropped = 0
+        self._until = 0.0
+        self._hung: Optional[threading.Thread] = None
+        self._events: List[dict] = []
+
+    def _note(self, action: str, reason: str) -> None:
+        self._events.append({"scope": "io", "action": action,
+                             "sink": self.name, "reason": reason})
+
+    def _degrade(self, reason: str) -> None:
+        self._until = time.monotonic() + self.cooldown
+        if not self.degraded:
+            self.degraded = True
+            self._note("degraded", reason)
+
+    def write(self, fn: Callable[[], object]) -> bool:
+        """Run one observability write; ``True`` iff it landed."""
+        if self._hung is not None:
+            if self._hung.is_alive():
+                # a previous attempt is still stuck in the kernel: do not
+                # stack a second stall on top of it — drop and stay loud
+                self.dropped += 1
+                self._degrade(f"{self.name}: previous write still hung "
+                              f"past the {self.deadline:.1f}s deadline")
+                return False
+            self._hung = None
+        if self.degraded and time.monotonic() < self._until:
+            self.dropped += 1
+            return False  # breaker open: drop until the cooldown probe
+        outcome: dict = {}
+
+        def _target():
+            try:
+                fn()
+                outcome["ok"] = True
+            # graftlint: disable=GL006 — the best-effort contract: ANY
+            # telemetry-write failure degrades loudly instead of killing
+            # (or poisoning) the training process that hosts it
+            except Exception as e:  # noqa: BLE001
+                outcome["error"] = repr(e)
+
+        for attempt in range(self.retries + 1):
+            worker = threading.Thread(
+                target=_target, daemon=True,
+                name=f"bestio-{self.name}")
+            worker.start()
+            worker.join(self.deadline)
+            if worker.is_alive():
+                self._hung = worker  # abandoned; skip fast while stuck
+                self.dropped += 1
+                self._degrade(f"{self.name}: write exceeded the "
+                              f"{self.deadline:.1f}s deadline (hung IO)")
+                return False
+            if outcome.get("ok"):
+                if self.degraded:
+                    self.degraded = False
+                    self._note("restored",
+                               f"{self.name}: write succeeded again after "
+                               f"{self.dropped} dropped write(s)")
+                    self.dropped = 0
+                return True
+            if attempt < self.retries:
+                time.sleep(self.backoff * (2 ** attempt))
+                outcome = {}
+        self.dropped += 1
+        self._degrade(f"{self.name}: write failed after "
+                      f"{self.retries + 1} attempt(s): "
+                      f"{outcome.get('error')}")
+        return False
+
+    def drain(self) -> List[dict]:
+        """Pop the pending degrade/restore payloads (scope ``io``)."""
+        events, self._events = self._events, []
+        return events

@@ -38,6 +38,7 @@ import numpy as np
 
 from .anomaly import AnomalyDetector, liveness
 from .attribution import critical_path_report
+from .bestio import BestEffortSink
 from .journal import append_journal_record, fmt_value, read_journal_tail
 
 __all__ = ["HeartbeatEmitter", "heartbeat_path", "read_heartbeats",
@@ -67,6 +68,9 @@ class HeartbeatEmitter:
         self.path = heartbeat_path(self.health_dir, self.host)
         self.ewma_alpha = float(ewma_alpha)
         self._ewma: Optional[float] = None
+        # best-effort IO (DESIGN.md §23): a heartbeat disk that hangs or
+        # fills must never stall or kill the training process it reports on
+        self._sink = BestEffortSink(f"heartbeat:{self.host}", deadline=2.0)
 
     def beat(self, epoch: int, step: int, steps: float, epoch_time: float,
              comm_time: float, workers: Dict[str, dict],
@@ -96,8 +100,16 @@ class HeartbeatEmitter:
                                  for k, v in stats.items()}
                         for w, stats in workers.items()},
         }
-        append_journal_record(self.path, "heartbeat", **payload)
+        self._sink.write(
+            lambda: append_journal_record(self.path, "heartbeat", **payload))
         return payload
+
+    def drain_recovery(self) -> List[dict]:
+        """Pop the sink's degrade/restore payloads (scope ``io``) — the
+        train loop journals each as a ``recovery`` event, which is how a
+        watcher learns the heartbeat file itself went quiet *on purpose*
+        (degraded) rather than the run dying."""
+        return self._sink.drain()
 
 
 def read_heartbeats(health_dir: str, tail: int = 8) -> Dict[str, List[dict]]:
@@ -203,6 +215,24 @@ def fleet_status(source: str, now: Optional[float] = None,
         # a dark host's workers are presumed down with it
         for worker in hosts[host]["workers"]:
             anomalies[(worker, "deadline_missed")] = {**a, "subject": worker}
+    # degraded-telemetry detection (DESIGN.md §23): when heartbeat writes
+    # are being dropped (ENOSPC / hung disk), the per-host files go quiet
+    # while the run is fine — the run journal's `recovery` events (scope
+    # `io`) are the loud record.  Surface the newest state per sink so the
+    # watch degrades loudly instead of lying about liveness.
+    run_journal = os.path.join(os.path.dirname(health_dir), "events.jsonl")
+    if os.path.exists(run_journal):
+        sink_state: Dict[str, dict] = {}  # newest io-recovery event per sink
+        for e in read_journal_tail(run_journal, 64):
+            if e.get("kind") == "recovery" and e.get("scope") == "io":
+                sink_state[str(e.get("sink"))] = e
+        for sink, e in sorted(sink_state.items()):
+            if e.get("action") != "degraded":
+                continue  # restored: the sink is healthy again
+            a = {"epoch": int(e.get("epoch", -1)), "subject": sink,
+                 "cause": "telemetry_degraded", "value": 1.0,
+                 "threshold": 0.0}
+            anomalies[(sink, "telemetry_degraded")] = a
     rates = [d["steps_per_sec"] for d in hosts.values()
              if d["steps_per_sec"] > 0]
     median_rate = float(np.median(rates)) if rates else 0.0

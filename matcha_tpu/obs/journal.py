@@ -31,15 +31,14 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
 __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
            "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "V4_KINDS", "V5_KINDS",
-           "V6_KINDS", "KIND_MIN_VERSION", "REQUIRED_FIELDS",
+           "V6_KINDS", "V7_KINDS", "KIND_MIN_VERSION", "REQUIRED_FIELDS",
            "make_event", "validate_event", "Journal", "read_journal",
-           "read_journal_tail", "resolve_journal_path", "latest_per_epoch",
-           "epoch_series", "append_journal_record"]
+           "salvage_journal", "read_journal_tail", "resolve_journal_path",
+           "latest_per_epoch", "epoch_series", "append_journal_record"]
 
 #: v2 (ISSUE 8) adds only new kinds — ``compile`` (the cost ledger's
 #: program introspection) and ``profile`` (overlap-truth trace analysis).
@@ -59,8 +58,8 @@ __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
 #: promotion pipeline decision (promote / rollback / retain with the
 #: gating held-out metric).  Every pre-bump event validates verbatim under
 #: the v6 reader — old journals stay first-class sources.
-SCHEMA_VERSION = 6
-ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5, 6})
+SCHEMA_VERSION = 7
+ACCEPTED_VERSIONS = frozenset({1, 2, 3, 4, 5, 6, 7})
 
 #: Every kind a journal may contain.  The five fault kinds keep their
 #: historical ``faults.json`` names so the view stays a pure filter.
@@ -92,16 +91,26 @@ V5_KINDS = frozenset({"backend"})
 #: control document at an epoch boundary), ``promotion`` every checkpoint
 #: promotion / rollback the serving pipeline makes.
 V6_KINDS = frozenset({"control", "promotion"})
+#: Kinds introduced by schema v7 (ISSUE 18) — ``recovery`` journals one
+#: durable-state recovery action: a corrupt checkpoint generation
+#: quarantined (scope ``checkpoint``), a torn/corrupt journal repaired or
+#: salvaged (scope ``journal``), an observability sink degraded to
+#: best-effort or restored (scope ``io``), a restart-budget credit
+#: refilled after sustained progress (scope ``budget``).  Recovery that
+#: does not journal is recovery that silently rewrites history — the
+#: chaos harness's invariants reject exactly that.
+V7_KINDS = frozenset({"recovery"})
 #: Minimum envelope version per kind — the generalized "a vK kind claiming
 #: an earlier v is a lying envelope" rule.
 KIND_MIN_VERSION: Dict[str, int] = {
     **{k: 2 for k in V2_KINDS}, **{k: 3 for k in V3_KINDS},
     **{k: 4 for k in V4_KINDS}, **{k: 5 for k in V5_KINDS},
-    **{k: 6 for k in V6_KINDS}}
+    **{k: 6 for k in V6_KINDS}, **{k: 7 for k in V7_KINDS}}
 EVENT_KINDS = frozenset({
     "run_start", "resume", "epoch", "telemetry", "drift", "checkpoint",
     "retrace", "bench",
-}) | FAULT_KINDS | V2_KINDS | V3_KINDS | V4_KINDS | V5_KINDS | V6_KINDS
+}) | FAULT_KINDS | V2_KINDS | V3_KINDS | V4_KINDS | V5_KINDS | V6_KINDS \
+    | V7_KINDS
 
 #: Kind-specific payload keys an event must carry to validate.  Kinds not
 #: listed need only the envelope (v / kind / t).
@@ -165,6 +174,13 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # ``action`` is promote / rollback / retain, ``metric`` the held-out
     # eval value that gated it.
     "promotion": frozenset({"action", "epoch", "metric"}),
+    # v7 (ISSUE 18): one per durable-state recovery action — ``scope``
+    # names the plane (checkpoint / journal / io / budget), ``action``
+    # what was done (quarantine / repair / salvage / degraded / restored /
+    # refill), ``reason`` why, in words.  Payload extras ride per scope
+    # (the quarantined path, the salvaged line count, the sink name) but
+    # the pinned triple is what every auditor can rely on.
+    "recovery": frozenset({"scope", "action", "reason"}),
 }
 
 
@@ -230,7 +246,12 @@ class Journal:
         self._flushed = int(count)
 
     def flush(self, events: Sequence[dict], rewrite: bool = False) -> int:
-        """Write pending events; returns how many lines were written."""
+        """Write pending events; returns how many lines were written.
+        IO goes through the ``obs.bestio`` fs seam, so the chaos harness
+        can inject ENOSPC/hung writes under the real journal."""
+        from .bestio import get_fs
+
+        fs = get_fs()
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         if rewrite:
             self._flushed = 0
@@ -239,12 +260,12 @@ class Journal:
             # truncate + full write: atomic via tmp so a crash mid-dump
             # cannot leave half a journal where a whole one existed
             tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
+            with fs.open(tmp, "w") as f:
                 for e in events:
                     f.write(_dump_line(e))
-            os.replace(tmp, self.path)
+            fs.replace(tmp, self.path)
         elif pending:
-            with open(self.path, "a") as f:
+            with fs.open(self.path, "a") as f:
                 for e in pending:
                     f.write(_dump_line(e))
         self._flushed = len(events)
@@ -266,19 +287,67 @@ def read_journal(path: str, repair: bool = False) -> List[dict]:
     """
     events: List[dict] = []
     lines = []
-    with open(path) as f:
+    # binary read + per-line decode: a line a bad disk filled with
+    # non-UTF-8 bytes is a malformed *line* (same contract as bad JSON),
+    # never a reader crash that takes the whole parseable file with it
+    with open(path, "rb") as f:
         for lineno, raw in enumerate(f, 1):
             if raw.strip():
                 lines.append((lineno, raw.strip()))
     for i, (lineno, line) in enumerate(lines):
         try:
-            events.append(json.loads(line))
-        except json.JSONDecodeError as e:
+            events.append(json.loads(line.decode("utf-8")))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
             if repair and i == len(lines) - 1:
                 break  # crash-truncated tail: drop it, keep the prefix
             raise ValueError(f"{path}:{lineno}: malformed journal line "
                              f"({e})") from e
     return events
+
+
+def salvage_journal(path: str) -> tuple:
+    """Salvage-prefix-and-quarantine for a journal corrupt **mid-stream**
+    (the case ``read_journal(repair=True)`` deliberately still raises on).
+
+    Returns ``(events, quarantine_path, problem)``: the valid prefix up to
+    the first malformed line, the path the damaged original was renamed
+    aside to (``events.jsonl.corrupt-N`` — evidence, never deleted), and a
+    one-line description of what was wrong.  ``quarantine_path`` is
+    ``None`` when the file parses clean (nothing to salvage; events are
+    the whole file, tail-repaired).
+
+    The contract this exists for: a resumed lifetime must not *brick* on
+    a journal a previous crash (or a bad disk) corrupted — it salvages
+    the readable history, moves the damaged file out of the append path,
+    journals a ``recovery`` event (the caller's job — Recorder.load_previous
+    does), and rewrites the stream whole.  Silent truncation without the
+    quarantine would be indistinguishable from history rewriting, which
+    is exactly what the chaos invariants reject.
+    """
+    events: List[dict] = []
+    problem = None
+    with open(path, "rb") as f:
+        lines = [(no, raw.strip()) for no, raw in enumerate(f, 1)
+                 if raw.strip()]
+    for i, (lineno, line) in enumerate(lines):
+        try:
+            events.append(json.loads(line.decode("utf-8")))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            if i == len(lines) - 1:
+                problem = (f"line {lineno}: crash-truncated tail "
+                           f"dropped ({e})")
+                return events, None, problem
+            problem = (f"line {lineno}: mid-stream corruption ({e}); "
+                       f"salvaged the {len(events)}-event prefix")
+            break
+    if problem is None:
+        return events, None, None
+    n = 1
+    while os.path.exists(f"{path}.corrupt-{n}"):
+        n += 1
+    quarantine = f"{path}.corrupt-{n}"
+    os.replace(path, quarantine)
+    return events, quarantine, problem
 
 
 def _tail_lines(f, n: int, block: int) -> List[bytes]:
@@ -331,8 +400,8 @@ def read_journal_tail(path: str, n: int, block: int = 65536) -> List[dict]:
         lines = _tail_lines(f, n + 1, block)
     for i, raw in enumerate(lines):
         try:
-            events.append(json.loads(raw))
-        except json.JSONDecodeError as e:
+            events.append(json.loads(raw.decode("utf-8")))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
             if i == len(lines) - 1:
                 break  # crash-truncated tail: drop it, keep the prefix
             raise ValueError(
@@ -388,13 +457,17 @@ def epoch_series(events: Iterable[dict], kind: str, field: str,
 def append_journal_record(path: str, kind: str, **fields) -> dict:
     """One-shot appender for standalone emitters (``bench.py --journal``,
     session stamps): no Recorder, no run clock — ``t`` is absolute unix
-    time, monotone within the file like any run journal.  Returns the
-    event written."""
-    event = make_event(kind, time.time(), **fields)
+    time (``bestio.wall_clock``: identical to ``time.time()`` outside the
+    chaos harness's skew injection), monotone within the file like any
+    run journal.  IO rides the ``obs.bestio`` fs seam.  Returns the event
+    written."""
+    from .bestio import get_fs, wall_clock
+
+    event = make_event(kind, wall_clock(), **fields)
     problems = validate_event(event)
     if problems:
         raise ValueError(f"refusing to journal invalid event: {problems}")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "a") as f:
+    with get_fs().open(path, "a") as f:
         f.write(_dump_line(event))
     return event

@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -60,8 +61,24 @@ class ServeConfig:
     promote_keep: int = 3
     eval_batch: int = 256
     restart_budget: int = 3  # crash relaunches before giving up
-    backoff: float = 1.0  # seconds, doubled per crash
+    backoff: float = 1.0  # seconds, decorrelated-jittered per crash
     backoff_max: float = 30.0
+    #: decorrelated-jitter RNG seed; None = nondeterministic (production),
+    #: an int pins the sleep schedule (the chaos campaign's exact replay)
+    jitter_seed: Optional[int] = None
+    #: K clean epoch boundaries of checkpointed progress refill one crash
+    #: credit (capped at restart_budget); 0 disables — without it a
+    #: week-long run with rare unrelated crashes deterministically aborts
+    refill_epochs: int = 0
+    #: crash-loop window (seconds): two consecutive crashes with the same
+    #: exit signature, both inside this window, escalate to checkpoint
+    #: quarantine + older-generation resume instead of burning the budget
+    #: on a deterministically poisoned artifact; 0 defaults to backoff_max
+    crash_window: float = 0.0
+    #: extra environment for the trainer subprocess (the chaos campaign's
+    #: injection path: kill specs / faulty-fs specs cross the process
+    #: boundary as env vars); None = inherit only
+    env: Optional[Dict] = None
 
     def __post_init__(self):
         if not isinstance(self.config, dict):
@@ -70,6 +87,10 @@ class ServeConfig:
                              "boundary as JSON)")
         if self.restart_budget < 0:
             raise ValueError("restart_budget must be >= 0")
+        if self.refill_epochs < 0:
+            raise ValueError("refill_epochs must be >= 0")
+        if self.crash_window < 0:
+            raise ValueError("crash_window must be >= 0")
         if self.promote_every < 0:
             raise ValueError("promote_every must be >= 0")
 
@@ -97,6 +118,11 @@ class Controller:
         self.last_exit: Optional[int] = None
         self._proc: Optional[subprocess.Popen] = None
         self._stopping = False
+        self._rng = random.Random(serve.jitter_seed)
+        #: checkpointed progress already converted into refill credits
+        self._refill_base: Optional[int] = None
+        #: previous crash's (exit code, latest checkpoint step, wall time)
+        self._last_crash: Optional[tuple] = None
 
     # ------------------------------------------------------------- plumbing
     def _write_spec(self) -> None:
@@ -131,6 +157,8 @@ class Controller:
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env = dict(os.environ)
+        if self.serve.env:
+            env.update({str(k): str(v) for k, v in self.serve.env.items()})
         env["PYTHONPATH"] = pkg_root + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
         return subprocess.Popen(
@@ -162,13 +190,79 @@ class Controller:
         self.config.update(merged)
         return merged
 
+    def _progress(self) -> Optional[int]:
+        """Latest checkpointed epoch, or ``None`` before any checkpoint —
+        the supervisor's only notion of "how far did training get"."""
+        if not os.path.isdir(self.ckpt_dir):
+            return None
+        from ..train import latest_step
+
+        return latest_step(self.ckpt_dir)
+
+    def _maybe_refill(self, progress: Optional[int]) -> None:
+        """Sustained healthy progress earns crash credits back: every
+        ``refill_epochs`` clean checkpointed epochs since the last refill
+        restore one credit (never below 0 used — the cap is the budget
+        itself).  Without this, a week-long run with rare unrelated
+        crashes deterministically aborts (ISSUE 18 satellite)."""
+        if not self.serve.refill_epochs or progress is None:
+            return
+        if self._refill_base is None:
+            self._refill_base = progress
+            return
+        delta = progress - self._refill_base
+        credits = min(delta // self.serve.refill_epochs, self.restarts_used)
+        if credits <= 0:
+            return
+        self.restarts_used -= credits
+        self._refill_base += credits * self.serve.refill_epochs
+        from ..obs.journal import append_journal_record
+
+        append_journal_record(
+            self.journal_path, "recovery", scope="budget", action="refill",
+            reason=f"{delta} clean checkpointed epoch(s) since the last "
+                   f"refill restored {credits} crash credit(s) "
+                   f"({self.restarts_used}/{self.serve.restart_budget} "
+                   f"used)", epoch=-1)
+
+    def _maybe_escalate(self, rc: int, progress: Optional[int],
+                        crashed_at: float) -> bool:
+        """Crash-loop detection: two consecutive crashes with the same
+        exit signature (exit code + checkpoint step they restored from),
+        spaced inside one crash window, mean the relaunch is
+        deterministically re-hitting the same poisoned artifact — burning
+        the rest of the budget on it is pointless.  Escalate: quarantine
+        the checkpoint generation both lifetimes resumed from, so the
+        next relaunch restores the next-oldest one."""
+        window = self.serve.crash_window or self.serve.backoff_max
+        sig = (rc, progress)
+        prev = self._last_crash
+        self._last_crash = (sig, crashed_at)
+        if (prev is None or prev[0] != sig or progress is None
+                or crashed_at - prev[1] > window):
+            return False
+        from ..obs.journal import append_journal_record
+        from ..train.checkpoint import quarantine_step
+
+        qpath = quarantine_step(self.ckpt_dir, progress)
+        append_journal_record(
+            self.journal_path, "recovery", scope="checkpoint",
+            action="quarantine",
+            reason=f"crash loop: two consecutive exits {rc} from "
+                   f"checkpoint step {progress} inside {window:.1f}s — "
+                   f"quarantined the generation; next relaunch resumes "
+                   f"from the next-oldest", epoch=-1,
+            quarantined=qpath)
+        self._last_crash = None  # the signature's cause was removed
+        return True
+
     # ----------------------------------------------------------- the daemon
     # graftcontract: root
     def run(self) -> int:
         """Supervise until the run completes, the budget exhausts, or
         ``shutdown()`` is called.  Returns the final exit code (0 on a
         clean completion)."""
-        backoff = self.serve.backoff
+        sleep = self.serve.backoff
         while True:
             self._proc = self._launch()
             rc = self._proc.wait()
@@ -183,8 +277,11 @@ class Controller:
                     reason=f"restart-scope control fields {sorted(merged)} "
                            f"merged; relaunching from checkpoint",
                     epoch=-1, fields=merged)
-                backoff = self.serve.backoff  # deliberate, not a crash
+                sleep = self.serve.backoff  # deliberate, not a crash
                 continue
+            progress = self._progress()
+            self._maybe_refill(progress)
+            self._maybe_escalate(rc, progress, time.monotonic())
             self.restarts_used += 1
             if self.restarts_used > self.serve.restart_budget:
                 journal_control(
@@ -197,10 +294,15 @@ class Controller:
                 self.journal_path, action="restart", applied=True,
                 reason=f"trainer crashed with exit {rc} (attempt "
                        f"{self.restarts_used}/{self.serve.restart_budget}, "
-                       f"backoff {backoff:.1f}s)",
+                       f"backoff {sleep:.1f}s)",
                 epoch=-1)
-            time.sleep(backoff)
-            backoff = min(backoff * 2, self.serve.backoff_max)
+            time.sleep(sleep)
+            # decorrelated jitter: next sleep drawn from [base, 3*previous]
+            # instead of a deterministic doubling — a fleet of daemons
+            # crashing together (shared-FS hiccup) de-synchronizes their
+            # relaunch stampede instead of re-colliding every 2^k seconds
+            sleep = min(self.serve.backoff_max,
+                        self._rng.uniform(self.serve.backoff, sleep * 3))
 
     def shutdown(self, timeout: float = 30.0) -> None:
         """Terminate the current trainer (SIGTERM, then SIGKILL after

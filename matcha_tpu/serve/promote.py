@@ -147,6 +147,12 @@ def _atomic_json(path: str, obj: dict) -> None:
         with os.fdopen(fd, "w") as f:
             json.dump(obj, f, indent=2, sort_keys=True)
             f.write("\n")
+        # chaos barrier (no-op unless armed): dying HERE leaves a stale
+        # tempfile next to the still-valid previous pointer — the
+        # torn-publish state readers must never see half of
+        from ..chaos.taps import maybe_kill
+
+        maybe_kill("mid_promote")
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):

@@ -153,6 +153,13 @@ class TrainerHarness:
                    if k in raw and getattr(seam.config, k) != raw[k]}
         if values:
             detail, predicted = self._apply_values(seam, values)
+            # chaos barrier (no-op unless armed): dying HERE — values
+            # applied in memory, decision not yet journaled — is the
+            # worst mid-control-swap state; recovery must re-apply the
+            # document idempotently, never observe it half-applied
+            from ..chaos.taps import maybe_kill
+
+            maybe_kill("mid_control")
             seam.recorder.log_event(
                 "control", action="apply", applied=True,
                 reason=f"value-scope fields {sorted(values)}",

@@ -1,7 +1,12 @@
 """Training layer: config, LR schedules, fused train step, driver loop,
 recorder, checkpointing."""
 
-from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    restore_with_fallback,
+    save_checkpoint,
+)
 from .config import TrainConfig
 from .loop import TrainingDiverged, TrainResult, build_dataset, build_schedule, train
 from .lr import make_lr_schedule
@@ -29,6 +34,7 @@ __all__ = [
     "make_optimizer",
     "make_train_step",
     "restore_checkpoint",
+    "restore_with_fallback",
     "save_checkpoint",
     "train",
 ]

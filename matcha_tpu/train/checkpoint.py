@@ -29,9 +29,17 @@ import orbax.checkpoint as ocp
 
 from .state import TrainState
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "saved_mix_pending_shape", "schedule_fingerprint",
-           "load_membership_sidecar"]
+__all__ = ["save_checkpoint", "restore_checkpoint", "restore_with_fallback",
+           "latest_step", "all_steps", "saved_mix_pending_shape",
+           "schedule_fingerprint", "load_membership_sidecar",
+           "checkpoint_digest", "verify_checkpoint_digest",
+           "quarantine_step", "ScheduleMismatch"]
+
+
+class ScheduleMismatch(ValueError):
+    """The resuming schedule disagrees with the checkpointed one — a
+    *configuration* error, never storage corruption: the generation
+    fallback ladder re-raises it instead of quarantining good data."""
 
 
 def _manager(directory: str) -> ocp.CheckpointManager:
@@ -74,6 +82,77 @@ def _membership_sidecar_path(directory: str, epoch: int) -> str:
                         f"membership-{epoch}.json")
 
 
+def _digest_path(directory: str, epoch: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"digest-{epoch}.json")
+
+
+def checkpoint_digest(directory: str, epoch: int) -> dict:
+    """Content digest of one orbax step directory: relative path →
+    sha256, every file.  Written as a sidecar at save; restore verifies
+    it before trusting the generation (DESIGN.md §23) — a bit-flip, a
+    truncation, or a deleted leaf file all fail the comparison *before*
+    orbax turns them into an opaque deserialization crash-loop."""
+    root = os.path.join(os.path.abspath(directory), str(int(epoch)))
+    files = {}
+    for base, _dirs, names in os.walk(root):
+        for name in sorted(names):
+            path = os.path.join(base, name)
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for block in iter(lambda: f.read(1 << 20), b""):
+                    h.update(block)
+            files[os.path.relpath(path, root)] = h.hexdigest()
+    return {"step": int(epoch), "files": files}
+
+
+def verify_checkpoint_digest(directory: str, epoch: int):
+    """``None`` when no digest sidecar exists (a pre-v7 checkpoint:
+    unverifiable, accepted), else the list of problems (empty = intact)."""
+    path = _digest_path(directory, int(epoch))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            saved = json.load(f)["files"]
+    except (ValueError, KeyError, OSError) as e:
+        return [f"digest sidecar unreadable: {e}"]
+    now = checkpoint_digest(directory, epoch)["files"]
+    problems = []
+    for rel in sorted(set(saved) - set(now)):
+        problems.append(f"{rel}: missing")
+    for rel in sorted(set(now) - set(saved)):
+        problems.append(f"{rel}: unexpected file")
+    for rel in sorted(set(saved) & set(now)):
+        if saved[rel] != now[rel]:
+            problems.append(f"{rel}: content hash mismatch")
+    return problems
+
+
+def quarantine_step(directory: str, epoch: int) -> str:
+    """Rename a damaged generation aside — step directory plus its
+    sidecars move under ``quarantine-<step>[-N]/`` — so the next restore
+    (and the next save at a colliding step number) never trips over it,
+    while the evidence survives for post-mortem.  Returns the quarantine
+    directory.  The caller journals the move (``recovery`` event): a
+    quarantine that does not journal is history silently rewritten."""
+    root = os.path.abspath(directory)
+    step = int(epoch)
+    base = os.path.join(root, f"quarantine-{step}")
+    dst, n = base, 1
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{base}-{n}"
+    os.makedirs(dst)
+    src = os.path.join(root, str(step))
+    if os.path.isdir(src):
+        os.rename(src, os.path.join(dst, str(step)))
+    for prefix in ("schedule-", "membership-", "digest-"):
+        side = os.path.join(root, f"{prefix}{step}.json")
+        if os.path.exists(side):
+            os.rename(side, os.path.join(dst, os.path.basename(side)))
+    return dst
+
+
 def load_membership_sidecar(directory: str, epoch: int):
     """The membership view recorded next to checkpoint ``epoch`` — pool
     occupancy (slot → worker id / last owner) plus the α scale that was
@@ -113,6 +192,20 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
     mgr.wait_until_finished()
     kept = set(int(s) for s in mgr.all_steps())
     mgr.close()
+    # chaos barrier (no-op unless armed): dying HERE leaves a committed
+    # step with no digest/schedule sidecar — the torn-save state the
+    # recovery ladder must restore through (DESIGN.md §23)
+    from ..chaos.taps import maybe_kill
+
+    maybe_kill("mid_save")
+    # integrity sidecar: the content digest the restore ladder verifies
+    # before trusting this generation — written atomically, like the rest
+    digest = checkpoint_digest(directory, epoch)
+    dpath = _digest_path(directory, epoch)
+    tmp = dpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(digest, f)
+    os.replace(tmp, dpath)
     if schedule is not None:
         # atomic write: a crash mid-dump must not leave a truncated sidecar
         # that later fails json.load during a legitimate resume
@@ -133,7 +226,17 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
     # checkpoint at the same epoch
     root = os.path.abspath(directory)
     for fname in os.listdir(root):
-        for prefix in ("schedule-", "membership-"):
+        if fname.endswith(".tmp"):
+            # a stale sidecar tempfile (crash mid-dump, or the chaos
+            # harness's stale-tempfile injector): never readable state,
+            # and leaving it would make every later listdir-based check
+            # trip over it forever
+            try:
+                os.remove(os.path.join(root, fname))
+            except OSError:
+                pass
+            continue
+        for prefix in ("schedule-", "membership-", "digest-"):
             if fname.startswith(prefix) and fname.endswith(".json"):
                 try:
                     step = int(fname[len(prefix):-len(".json")])
@@ -153,6 +256,17 @@ def latest_step(directory: str) -> Optional[int]:
     step = mgr.latest_step()
     mgr.close()
     return step
+
+
+def all_steps(directory: str):
+    """Every generation on disk, oldest→newest (the fallback ladder's
+    iteration order, reversed)."""
+    if not os.path.isdir(directory):
+        return []
+    mgr = _manager(directory)
+    steps = sorted(int(s) for s in mgr.all_steps())
+    mgr.close()
+    return steps
 
 
 def saved_mix_pending_shape(directory: str,
@@ -280,7 +394,7 @@ def restore_checkpoint(directory: str, template: TrainState,
     if schedule is not None:
         cursor = int(np.asarray(state.step))
         if cursor > schedule.iterations:
-            raise ValueError(
+            raise ScheduleMismatch(
                 f"restored schedule cursor {cursor} exceeds the resuming "
                 f"schedule's horizon {schedule.iterations}; extend() the "
                 f"schedule (or resume with the one that was checkpointed)"
@@ -290,7 +404,7 @@ def restore_checkpoint(directory: str, template: TrainState,
             with open(sidecar) as f:
                 saved = json.load(f)
             if saved["iterations"] > schedule.iterations:
-                raise ValueError(
+                raise ScheduleMismatch(
                     f"resuming schedule ({schedule.iterations} steps) is "
                     f"shorter than the checkpointed stream "
                     f"({saved['iterations']} steps); its flag stream cannot "
@@ -302,7 +416,7 @@ def restore_checkpoint(directory: str, template: TrainState,
                 if now[key] != saved[key]:
                     what = ("matchings/alpha/probs" if key == "static_digest"
                             else "activation-flag stream")
-                    raise ValueError(
+                    raise ScheduleMismatch(
                         f"schedule {what} differs from the checkpointed "
                         f"schedule (fingerprint mismatch); resuming would "
                         f"de-synchronize the gossip schedule from its "
@@ -310,3 +424,66 @@ def restore_checkpoint(directory: str, template: TrainState,
                         f"original graph/budget/seed/sampler."
                     )
     return state, int(step)
+
+
+def restore_with_fallback(directory: str, template: Optional[TrainState] = None,
+                          schedule=None, notices: Optional[list] = None,
+                          template_fn=None):
+    """Generation fallback ladder (DESIGN.md §23): restore the newest
+    checkpoint that is both digest-intact and loadable, quarantining every
+    generation that fails on the way down.  Returns ``(state, epoch)``.
+
+    Without it, a corrupted *latest* checkpoint is a deterministic
+    crash-loop — every supervised relaunch restores ``latest_step`` and
+    re-hits the same corrupt artifact until the restart budget burns.
+
+    * ``template_fn(step)`` (when given) builds the restore template per
+      generation — resume needs this because the ``mix_pending`` probe
+      shape is read from the specific step's metadata; plain ``template``
+      serves every rung otherwise.
+    * A generation whose digest sidecar disagrees with disk, or whose
+      restore raises anything *except* :class:`ScheduleMismatch`, is moved
+      aside via :func:`quarantine_step` and appended to ``notices`` as
+      ``{"step", "path", "reason"}`` — the caller journals each as a
+      ``recovery`` event (scope ``checkpoint``).
+    * :class:`ScheduleMismatch` re-raises immediately: the *schedule* is
+      wrong, not the storage, and the next-oldest generation would fail
+      identically — quarantining good data over a config error is the one
+      thing the ladder must never do.
+    * Raises ``FileNotFoundError`` with no generations on disk, and
+      ``ValueError`` listing every failure when all generations fail.
+    """
+    steps = all_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+    if notices is None:
+        notices = []
+    errors = []
+    for step in reversed(steps):
+        problems = verify_checkpoint_digest(directory, step)
+        if problems:  # None (no sidecar: pre-v7, unverifiable) passes
+            reason = (f"digest verification failed: "
+                      f"{'; '.join(problems[:3])}"
+                      + (f" (+{len(problems) - 3} more)"
+                         if len(problems) > 3 else ""))
+            path = quarantine_step(directory, step)
+            notices.append({"step": step, "path": path, "reason": reason})
+            errors.append(f"step {step}: {reason}")
+            continue
+        tpl = template_fn(step) if template_fn is not None else template
+        try:
+            return restore_checkpoint(directory, tpl, epoch=step,
+                                      schedule=schedule)
+        except ScheduleMismatch:
+            raise  # config error, not corruption: never quarantine for it
+        # graftlint: disable=GL006 — the ladder's whole job: ANY other
+        # restore failure (orbax deserialization, truncated array, missing
+        # leaf) quarantines this generation and tries the next-oldest
+        except Exception as e:  # noqa: BLE001
+            reason = f"restore failed: {e!r}"
+            path = quarantine_step(directory, step)
+            notices.append({"step": step, "path": path, "reason": reason})
+            errors.append(f"step {step}: {reason}")
+    raise ValueError(
+        "every checkpoint generation failed to restore — "
+        + "; ".join(errors))

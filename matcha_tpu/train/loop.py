@@ -535,19 +535,29 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None,
         # from a one-step run, [N, K', D] from a staleness ring, absent
         # from an eager run), restore through a probe of that shape, then
         # reconcile with this run's overlap/staleness contract.
-        from .checkpoint import saved_mix_pending_shape
+        from .checkpoint import restore_with_fallback, saved_mix_pending_shape
 
-        probe_shape = saved_mix_pending_shape(resume_dir) \
-            or (config.num_workers, flattener.dim)
-        pend0 = jnp.zeros(probe_shape, jnp.float32)
-        if mesh is not None:
-            pend0 = shard_workers(pend0, mesh)  # match the state's sharding
+        def _restore_template(step):
+            probe_shape = saved_mix_pending_shape(resume_dir, epoch=step) \
+                or (config.num_workers, flattener.dim)
+            pend0 = jnp.zeros(probe_shape, jnp.float32)
+            if mesh is not None:
+                pend0 = shard_workers(pend0, mesh)  # match state's sharding
+            return state.replace(mix_pending=pend0)
+
         # telemetry is never checkpointed (per-epoch scratch): the
         # save/restore pair strips it internally, and the caller's slot
         # passes through — re-primed fresh below either way (mix_ages
-        # rides the same strip; the reconcile rebuilds it from the cursor)
-        state, last_epoch = restore_checkpoint(
-            resume_dir, state.replace(mix_pending=pend0), schedule=schedule)
+        # rides the same strip; the reconcile rebuilds it from the cursor).
+        # The generation fallback ladder (DESIGN.md §23) replaces the bare
+        # latest-step restore: a corrupted latest checkpoint quarantines
+        # and falls back to the next-oldest instead of crash-looping the
+        # supervisor's restart budget away; each quarantine is collected
+        # here and journaled once the recorder exists below.
+        recovery_notices = []
+        state, last_epoch = restore_with_fallback(
+            resume_dir, schedule=schedule, notices=recovery_notices,
+            template_fn=_restore_template)
         start_epoch = last_epoch + 1
         state = _reconcile_mix_pending(state, config.overlap, communicator,
                                        flattener, config.num_workers,
@@ -645,6 +655,15 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None,
         # own audit trail (unsupervised reruns into a reused folder keep
         # the historical rewrite semantics)
         recorder.load_previous(start_epoch)
+    if resume_dir is not None:
+        for n in recovery_notices:
+            # the quarantine already happened during restore (before the
+            # recorder existed) — journal it now so the move is on the
+            # record: a quarantine nobody can read about is history
+            # silently rewritten
+            recorder.log_event("recovery", scope="checkpoint",
+                               action="quarantine", reason=n["reason"],
+                               epoch=n["step"], quarantined=n["path"])
     if fault_plan is not None:
         plan_events = fault_plan.to_json()["events"]
         already = any(e.get("kind") == "plan" and e.get("events") == plan_events
@@ -873,6 +892,12 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None,
 
     epoch = start_epoch
     while epoch < config.epochs:
+        # chaos barrier (no-op unless armed): the campaign's SIGKILL-at-
+        # epoch-boundary injector fires here, before any of this epoch's
+        # host-state transitions (DESIGN.md §23)
+        from ..chaos.taps import maybe_kill
+
+        maybe_kill("epoch_boundary")
         if boundary_hook is not None:
             # the control plane's one entry point: apply pending control
             # documents, run the promotion cadence, then re-prime the
@@ -1225,6 +1250,14 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None,
                 recorder.log_event("heartbeat", **hb)
                 for a in anomaly_detector.observe(hb):
                     recorder.log_event("anomaly", **a)
+                for ev in health_emitter.drain_recovery():
+                    # the heartbeat sink degraded or recovered: the run
+                    # journal is the loud record a watcher reads when the
+                    # per-host files themselves go quiet (DESIGN.md §23)
+                    recorder.log_event("recovery", scope="io",
+                                       action=ev["action"],
+                                       reason=ev["reason"],
+                                       sink=ev["sink"], epoch=epoch)
         _watch_retrace(e_scan if config.scan_epoch else e_step)
 
         if config.save and recorder.epochs_recorded % 10 == 0:

@@ -42,7 +42,9 @@ from typing import Dict, List
 
 import numpy as np
 
-from ..obs.journal import FAULT_KINDS, Journal, make_event, read_journal
+from ..obs.bestio import BestEffortSink, get_fs
+from ..obs.journal import (FAULT_KINDS, Journal, make_event,
+                           read_journal, salvage_journal)
 
 __all__ = ["Recorder"]
 
@@ -85,6 +87,9 @@ class Recorder:
         self._flushed_epochs = 0
         self._csv_rewrite = True
         self._journal_rewrite = True
+        # best-effort IO contract (DESIGN.md §23): a save that hangs or
+        # hits ENOSPC degrades loudly instead of stalling/killing training
+        self._sink = BestEffortSink("recorder", deadline=10.0)
 
     # ------------------------------------------------------------- journal
     def log_event(self, kind: str, **detail) -> dict:
@@ -186,14 +191,35 @@ class Recorder:
             # happened the on-disk file is longer than the parsed prefix,
             # and appending after the broken tail would corrupt the stream
             # mid-file — schedule a full rewrite from memory instead
-            self.events = read_journal(jpath, repair=True)
-            with open(jpath) as f:
-                disk_lines = sum(1 for line in f if line.strip())
+            try:
+                self.events = read_journal(jpath, repair=True)
+                with open(jpath) as f:
+                    disk_lines = sum(1 for line in f if line.strip())
+            except ValueError:
+                # mid-stream corruption: repair cannot drop an interior
+                # line without rewriting history — salvage the clean
+                # prefix, quarantine the damaged file, rebuild from memory
+                events, qpath, problem = salvage_journal(jpath)
+                self.events = events
+                disk_lines = -1  # force the rewrite branch below
+                self.journal.mark_flushed(0)
+                self.log_event("recovery", scope="journal",
+                               action="salvage", reason=problem,
+                               quarantined=qpath)
             if disk_lines == len(self.events):
                 self.journal.mark_flushed(len(self.events))
                 self._journal_rewrite = False
             else:
                 self._journal_rewrite = True
+                if disk_lines > len(self.events):
+                    # torn tail: repair dropped the crash-truncated final
+                    # line(s).  Journal the repair — a dropped tail that
+                    # is not journaled is history silently rewritten.
+                    self.log_event(
+                        "recovery", scope="journal", action="repair",
+                        reason=f"crash-truncated tail: dropped "
+                               f"{disk_lines - len(self.events)} "
+                               f"unparseable line(s) on resume")
         else:
             ledger = os.path.join(self.folder, "faults.json")
             if os.path.exists(ledger):
@@ -255,9 +281,22 @@ class Recorder:
             rows.append(float(arr[rank]) if arr.ndim else float(arr))
         return np.asarray(rows)
 
-    def save(self):
-        """Flush: CSV rows added since the last save (append-only), the
-        ExpDescription, the ``faults.json`` view, and the journal."""
+    def save(self) -> bool:
+        """Flush — best-effort: the write runs behind ``BestEffortSink``'s
+        deadline + breaker, so a hung or ENOSPC'd telemetry disk degrades
+        loudly (``recovery`` events, scope ``io``) instead of stalling or
+        killing the training process.  Returns ``True`` iff it landed."""
+        ok = self._sink.write(self._save_now)
+        for ev in self._sink.drain():
+            self.log_event("recovery", scope="io", action=ev["action"],
+                           reason=ev["reason"], sink=ev["sink"])
+        return ok
+
+    def _save_now(self):
+        """The actual flush: CSV rows added since the last save
+        (append-only), the ExpDescription, the ``faults.json`` view, and
+        the journal — every write through the chaos-injectable fs seam."""
+        fs = get_fs()
         os.makedirs(self.folder, exist_ok=True)
         cfg = self.config
         total = self.epochs_recorded
@@ -269,17 +308,18 @@ class Recorder:
                 path = os.path.join(self.folder, prefix + kind + ".log")
                 new_rows = self._series_for_worker(kind, rank, start=start)
                 if rewrite or not os.path.exists(path):
-                    np.savetxt(path, new_rows, delimiter=",", fmt=_FMT)
+                    with fs.open(path, "w") as f:
+                        np.savetxt(f, new_rows, delimiter=",", fmt=_FMT)
                 elif len(new_rows):
                     # byte-identical to what the full savetxt would append:
                     # same fmt, one value per line, trailing newline
-                    with open(path, "a") as f:
+                    with fs.open(path, "a") as f:
                         for v in new_rows:
                             f.write((_FMT % v) + "\n")
         self._flushed_epochs = total
         self._csv_rewrite = False
         desc = os.path.join(self.folder, "ExpDescription")
-        with open(desc, "w") as f:
+        with fs.open(desc, "w") as f:
             f.write(f"{cfg.name} {cfg.description}\n")
             for field in dataclasses.fields(cfg):
                 f.write(f"{field.name}: {getattr(cfg, field.name)}\n")
@@ -289,9 +329,9 @@ class Recorder:
             # atomic like the checkpoint sidecar: a crash mid-dump must not
             # leave truncated JSON for the verifier to choke on
             tmp = path + ".tmp"
-            with open(tmp, "w") as f:
+            with fs.open(tmp, "w") as f:
                 json.dump({"events": faults}, f, indent=1)
-            os.replace(tmp, path)
+            fs.replace(tmp, path)
         elif os.path.exists(path):
             # a fault-free rerun into the same folder must not leave a
             # previous run's ledger behind: plan-verify would silently score

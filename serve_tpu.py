@@ -57,6 +57,9 @@ def cmd_run(args) -> int:
         eval_batch=args.eval_batch,
         restart_budget=args.restart_budget,
         backoff=args.backoff,
+        jitter_seed=args.jitter_seed,
+        refill_epochs=args.refill_epochs,
+        crash_window=args.crash_window,
     ))
     endpoint = None
     if not args.no_endpoint:
@@ -147,6 +150,15 @@ def main(argv=None) -> int:
     s.add_argument("--eval-batch", type=int, default=256)
     s.add_argument("--restart-budget", type=int, default=3)
     s.add_argument("--backoff", type=float, default=1.0)
+    s.add_argument("--jitter-seed", type=int, default=None,
+                   help="pin the decorrelated backoff jitter (chaos replay)")
+    s.add_argument("--refill-epochs", type=int, default=0,
+                   help="checkpointed epochs per restored crash credit "
+                        "(0 disables budget refill)")
+    s.add_argument("--crash-window", type=float, default=0.0,
+                   help="crash-loop window seconds (0 = backoff max): two "
+                        "same-signature crashes inside it quarantine the "
+                        "checkpoint generation they resumed from")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=0,
                    help="endpoint port (0 = ephemeral, printed at start)")

@@ -413,8 +413,9 @@ def test_gl202_registry_extraction_folds_the_real_registry():
     assert reg["SCHEMA_VERSION"] == max(reg["ACCEPTED_VERSIONS"])
     assert "backend" in reg["EVENT_KINDS"]
     assert reg["KIND_MIN_VERSION"]["backend"] == 5
-    assert reg["KIND_MIN_VERSION"]["control"] == reg["SCHEMA_VERSION"]
-    assert reg["KIND_MIN_VERSION"]["promotion"] == reg["SCHEMA_VERSION"]
+    assert reg["KIND_MIN_VERSION"]["control"] == 6
+    assert reg["KIND_MIN_VERSION"]["promotion"] == 6
+    assert reg["KIND_MIN_VERSION"]["recovery"] == reg["SCHEMA_VERSION"]
     assert set(reg["REQUIRED_FIELDS"]) <= set(reg["EVENT_KINDS"])
 
 
@@ -438,7 +439,7 @@ def test_gl202_new_kind_without_min_version_fires(tmp_path):
 
 def test_gl202_min_version_beyond_schema_version_fires(tmp_path):
     src = _tampered_journal(
-        tmp_path, '**{k: 6 for k in V6_KINDS}}', '**{k: 7 for k in V6_KINDS}}')
+        tmp_path, '**{k: 7 for k in V7_KINDS}}', '**{k: 8 for k in V7_KINDS}}')
     vs = lint_source(src, list(CONTRACT_RULES))
     assert any("SCHEMA_VERSION" in v.message and v.rule == "GL202"
                for v in vs)
@@ -446,13 +447,13 @@ def test_gl202_min_version_beyond_schema_version_fires(tmp_path):
 
 def test_gl202_version_bump_without_a_new_kind_fires(tmp_path):
     src = _tampered_journal(
-        tmp_path, "SCHEMA_VERSION = 6\nACCEPTED_VERSIONS = "
-                  "frozenset({1, 2, 3, 4, 5, 6})",
-        "SCHEMA_VERSION = 7\nACCEPTED_VERSIONS = "
-        "frozenset({1, 2, 3, 4, 5, 6, 7})")
+        tmp_path, "SCHEMA_VERSION = 7\nACCEPTED_VERSIONS = "
+                  "frozenset({1, 2, 3, 4, 5, 6, 7})",
+        "SCHEMA_VERSION = 8\nACCEPTED_VERSIONS = "
+        "frozenset({1, 2, 3, 4, 5, 6, 7, 8})")
     vs = lint_source(src, list(CONTRACT_RULES))
     assert _ids(vs) == ["GL202"]
-    assert "no kind is introduced at v7" in vs[0].message
+    assert "no kind is introduced at v8" in vs[0].message
 
 
 # ===================================================================== GL203

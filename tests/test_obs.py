@@ -187,16 +187,21 @@ def test_reference_journal_validates_line_by_line():
     the rejoin re-fold) carrying the re-based drift prediction — which is
     exactly what keeps `obs_tpu drift` exit 0 on this journal
     (test_cli_drift_exit_codes): the replay re-bases at the swap like the
-    live monitor did."""
+    live monitor did.  ISSUE 18 re-pins at v7 with the recovery ladder:
+    the recipe checkpoints every epoch (`checkpoint` events + digest
+    sidecars) and the regeneration script bit-flips the newest
+    generation, lets the sidecar convict it, quarantines it through the
+    real helpers, and appends the resulting `recovery` event."""
     events = read_journal(str(REPO / "benchmarks" / "events_ring8.jsonl"))
     assert events, "reference journal is empty"
     for i, e in enumerate(events):
         assert validate_event(e) == [], f"line {i + 1}: {validate_event(e)}"
-    assert {e["v"] for e in events} == {6}
+    assert {e["v"] for e in events} == {7}
     kinds = {e["kind"] for e in events}
     assert {"run_start", "epoch", "telemetry", "compile",
             "membership", "heartbeat", "anomaly", "attribution",
-            "backend", "control", "promotion"} <= kinds
+            "backend", "control", "promotion", "checkpoint",
+            "recovery"} <= kinds
     leave, rejoin = [e for e in events if e["kind"] == "membership"]
     assert (leave["epoch"], rejoin["epoch"]) == (2, 5)
     assert [t["kind"] for t in leave["trigger"]] == ["leave"]
@@ -268,6 +273,17 @@ def test_reference_journal_validates_line_by_line():
     assert (promo["action"], promo["epoch"], promo["serving_epoch"]) \
         == ("promote", 4, 4)
     assert 0.0 <= promo["metric"] <= 1.0 and len(promo["content_hash"]) == 16
+    # v7 recovery plane: per-epoch checkpoints and the quarantine the
+    # regeneration script forced through the real ladder helpers (a
+    # bit-flipped newest generation convicted by its digest sidecar)
+    checkpoints = [e for e in events if e["kind"] == "checkpoint"]
+    assert [e["epoch"] for e in checkpoints] == list(range(8))
+    [recovery] = [e for e in events if e["kind"] == "recovery"]
+    assert (recovery["scope"], recovery["action"]) \
+        == ("checkpoint", "quarantine")
+    assert recovery["epoch"] == 7
+    assert "digest verification failed" in recovery["reason"]
+    assert recovery["quarantined"].endswith("quarantine-7")
     assert not [e for e in events if e["kind"] == "retrace"]
 
 
@@ -441,6 +457,40 @@ def test_v6_kinds_are_versioned_and_v1_to_v5_validate_verbatim(tmp_path):
                           applied=True, reason="operator stop document")
     assert read_journal(str(path))[:-1] == pre_bump  # grown, not rewritten
     assert path.read_bytes().startswith(before)
+
+
+def test_v7_recovery_kind_is_versioned_and_v6_validates_verbatim():
+    """The v6→v7 bump (ISSUE 18) is additive: `recovery` is the one new
+    kind, it requires its scope/action/reason payload, and a `recovery`
+    event claiming v<=6 is a lying envelope; v6 serve-plane events
+    validate verbatim under the v7 reader."""
+    from matcha_tpu.obs.journal import (
+        EVENT_KINDS,
+        KIND_MIN_VERSION,
+        SCHEMA_VERSION,
+        V7_KINDS,
+    )
+
+    assert SCHEMA_VERSION == 7
+    assert V7_KINDS == {"recovery"}
+    assert V7_KINDS <= EVENT_KINDS
+    recovery = {"v": 7, "kind": "recovery", "t": 1.0, "epoch": 3,
+                "scope": "checkpoint", "action": "quarantine",
+                "reason": "digest verification failed: a.bin: "
+                          "content hash mismatch",
+                "quarantined": "runs/x_ckpt/quarantine-3"}
+    assert KIND_MIN_VERSION["recovery"] == 7
+    assert validate_event(recovery) == []
+    for v in (1, 2, 3, 4, 5, 6):
+        assert any("v7 kind" in p
+                   for p in validate_event({**recovery, "v": v}))
+    assert any("missing" in p for p in validate_event(
+        {k: v for k, v in recovery.items() if k != "scope"}))
+    v6_control = {"v": 6, "kind": "control", "t": 1.0, "epoch": 3,
+                  "action": "apply", "applied": True, "version": 2,
+                  "reason": "value-scope fields ['budget']",
+                  "fields": {"budget": {"budget": 0.25}}}
+    assert validate_event(v6_control) == []
 
 
 def test_read_journal_tail_is_bounded_and_exact(tmp_path):
@@ -629,11 +679,17 @@ def test_journal_repairs_crash_truncated_tail(tmp_path):
         read_journal(rec.journal.path)
     rec2 = Recorder(cfg, 4)
     rec2.load_previous(3)
-    assert len(rec2.events) == whole  # parsed prefix, tail dropped
+    # parsed prefix, tail dropped — and the repair journals itself as a
+    # v7 `recovery` event (ISSUE 18: silent repair is history rewritten)
+    assert len(rec2.events) == whole + 1
+    repair = rec2.events[-1]
+    assert (repair["kind"], repair["scope"], repair["action"]) \
+        == ("recovery", "journal", "repair")
     _feed(rec2, np.random.default_rng(1), 1)
     rec2.save()
     healed = read_journal(rec2.journal.path)  # strict read: whole again
-    assert len(healed) == whole + 1  # prefix + the one post-resume epoch
+    # prefix + the repair record + the one post-resume epoch
+    assert len(healed) == whole + 2
     # a malformed line mid-file is corruption, not a crash tail: loud even
     # with repair on
     bad = tmp_path / "bad.jsonl"
