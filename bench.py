@@ -338,6 +338,81 @@ def staleness_grid(sched, x, steps, n, dim, backend="dense",
     return cells
 
 
+def elision_grid(sched, x, steps, n, dim, backends=("skip", "dense", "perm"),
+                 local_steps=(1, 4), reps=2, time_left=None):
+    """The universal-elision A/B (ISSUE 19): backend × local_every cells,
+    each carrying the *measured* chain rate and the compiled-cost ledger's
+    per-epoch gossip-attributed boundary bytes
+    (``obs.costs.elision_epoch_costs``).
+
+    The A/B by construction: ``skip`` runs its historical flag-thinned
+    stream through ``Communicator.run`` — thinning at the flag level, the
+    only backend that elided before the restructure — while ``dense`` and
+    ``perm`` run ``Communicator.run_elided``, the chain-level twin of the
+    restructured epoch's cond-in-body scan.  At L=4 every backend's bytes
+    column must show the thinned steps' traffic *gone* (≥2× vs L=1, the
+    acceptance pin), and the measured column shows what that buys in
+    steps/s on this chip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from matcha_tpu.communicator import make_decen
+    from matcha_tpu.obs.costs import elision_epoch_costs
+
+    steps = min(steps, len(sched.flags))
+    cells = []
+    for backend in backends:
+        comm = make_decen(sched, backend=backend)
+        for L in local_steps:
+            if time_left is not None and time_left() < 10.0:
+                # no silent caps: the emitted grid says what was dropped
+                print(f"# elision grid truncated at {len(cells)}/"
+                      f"{len(backends) * len(local_steps)} cells: "
+                      f"{time_left():.0f}s left", file=sys.stderr)
+                return cells
+            flags = np.asarray(sched.flags, np.float32)[:steps].copy()
+            if backend == "skip":
+                # skip's own semantics: thin the flag stream, run it all
+                if L > 1:
+                    flags[np.arange(steps) % L != 0] = 0.0
+                fj = jnp.asarray(flags)
+                run = jax.jit(lambda v: jnp.sum(
+                    comm.run(v, fj)[0][:, :8].astype(jnp.float32)))
+            else:
+                fj = jnp.asarray(flags)
+                run = jax.jit(lambda v, LL=L: jnp.sum(
+                    comm.run_elided(v, fj, LL)[0][:, :8]
+                    .astype(jnp.float32)))
+            float(run(x))  # compile + warmup (forced readback)
+            rates = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(run(x))
+                rates.append(steps / (time.perf_counter() - t0))
+            try:
+                costs = elision_epoch_costs(n, dim, sched.decomposed,
+                                            backend=backend, t_steps=steps,
+                                            local_every=L)
+                ledger = {
+                    "hbm_bytes_per_epoch":
+                        costs["gossip_hbm_bytes_per_epoch"],
+                    "hbm_bytes_per_step": costs["gossip_hbm_bytes_per_step"],
+                    "exec_steps": costs["exec_steps"],
+                }
+            except Exception as e:  # noqa: BLE001 — ledger is a refinement
+                print(f"# elision ledger failed ({backend}, L={L}): "
+                      f"{type(e).__name__}: {str(e)[:200]}", file=sys.stderr)
+                ledger = {}
+            cells.append({
+                "backend": backend, "local_every": L,
+                "value": round(max(rates), 1),
+                "unit": "gossip_steps_per_sec",
+                **ledger,
+            })
+    return cells
+
+
 def roofline(backend, value, n, dim, dtype, block_d=2048, chunk=1, m=0):
     """Per-step FLOP and HBM-byte model for the Pallas/MXU backends,
     evaluated at the measured rate.  The fused kernel's traffic model is
@@ -485,6 +560,21 @@ def worker_main(args) -> int:
             except Exception as e:  # noqa: BLE001 — grid is a refinement
                 print(f"# staleness grid failed: {type(e).__name__}: "
                       f"{str(e)[:200]}", file=sys.stderr)
+        if (args.backend == "dense" and args.elision_grid_steps
+                and time_left() > 30.0):
+            # same budget discipline: 6 cells × (warmup + 2 reps) of an
+            # elided chain, each no slower than the rate just measured
+            budget = min(60.0, max(time_left() - 30.0, 0.0))
+            gsteps = max(4, min(args.elision_grid_steps, steps,
+                                int(value * budget / 54)))
+            try:
+                record["elision_grid"] = elision_grid(
+                    sched, x, gsteps, n, dim, time_left=time_left)
+                print(json.dumps(record))
+                sys.stdout.flush()
+            except Exception as e:  # noqa: BLE001 — grid is a refinement
+                print(f"# elision grid failed: {type(e).__name__}: "
+                      f"{str(e)[:200]}", file=sys.stderr)
         return 0
 
     # --- primary: per-step (training-regime) fused kernel, chunk=1 ---------
@@ -621,6 +711,22 @@ def worker_main(args) -> int:
         print(f"# staleness grid skipped: {time_left():.0f}s left",
               file=sys.stderr)
 
+    # --- universal-elision grid (ISSUE 19): backend × local_every cells ---
+    # measured elided-chain rate + the ledger's per-epoch gossip bytes
+    if args.elision_grid_steps and time_left() > 45.0:
+        try:
+            record["elision_grid"] = elision_grid(
+                sched, x, args.elision_grid_steps, n, dim,
+                time_left=time_left)
+            print(json.dumps(record))
+            sys.stdout.flush()
+        except Exception as e:  # noqa: BLE001 — grid is a refinement
+            print(f"# elision grid failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+    elif args.elision_grid_steps:
+        print(f"# elision grid skipped: {time_left():.0f}s left",
+              file=sys.stderr)
+
     # --- secondary: chunked chain composition (consensus-only regime) ------
     if args.chunk > 1 and time_left() < 45.0:
         print(f"# chunked secondary skipped: {time_left():.0f}s left",
@@ -725,7 +831,8 @@ def orchestrate(args, passthrough) -> int:
                "--workers", str(args.workers),
                "--deadline", str(time.time() + args.provisional_timeout - 15.0),
                "--overlap-grid-steps", str(args.overlap_grid_steps),
-               "--staleness-grid-steps", str(args.staleness_grid_steps)]
+               "--staleness-grid-steps", str(args.staleness_grid_steps),
+               "--elision-grid-steps", str(args.elision_grid_steps)]
     if args.smoke:
         cpu_cmd.append("--smoke")
     rc, out, err, timed_out, secs = _run_bounded(
@@ -950,6 +1057,13 @@ def main():
                         "barrier-vs-bounded fleet wall-clock under a "
                         "planted period-4 straggler, with the straggler "
                         "tax priced through critical_path_report")
+    p.add_argument("--elision-grid-steps", type=int, default=120,
+                   dest="elision_grid_steps",
+                   help="chain length per universal-elision grid cell "
+                        "(backend in {skip,dense,perm} x local_every in "
+                        "{1,4}; 0 disables): measured elided-chain rate + "
+                        "the compiled-cost ledger's per-epoch gossip-"
+                        "attributed boundary bytes (the ISSUE 19 A/B)")
     p.add_argument("--workers", type=int, default=256)
     p.add_argument("--attempt-timeout", type=float, default=240.0,
                    help="wall-clock bound per TPU measurement attempt (s)")
@@ -1013,7 +1127,8 @@ def main():
                     "--w-window", str(args.w_window),
                     "--w-sweep", args.w_sweep,
                     "--overlap-grid-steps", str(args.overlap_grid_steps),
-                    "--staleness-grid-steps", str(args.staleness_grid_steps)]
+                    "--staleness-grid-steps", str(args.staleness_grid_steps),
+                    "--elision-grid-steps", str(args.elision_grid_steps)]
     if args.force_attempt_failure:  # test hook rides only the TPU attempts;
         passthrough.append("--force-attempt-failure")  # the provisional stays real
     return orchestrate(args, passthrough)

@@ -201,6 +201,32 @@ timeout -k 30 1800 python chaos_tpu.py campaign --trials 26 \
     || echo "chaos_r8: campaign FAILED (see benchmarks/chaos_r8.md)"
 rm -rf benchmarks/chaos_run_r8
 
+# 1.97 elision_r8 + profile_overlap_r8 (ISSUE 19: universal local-step
+#      elision + double-buffered perm windows on real hardware).
+#      elision_r8.json: the bench's elision_grid (skip/dense/perm x
+#      local_every in {1,4}) rides the driver record — measured
+#      gossip-steps/s next to the ledger's per-epoch boundary bytes, the
+#      A/B the >=2x byte-reduction claim ships with (tests pin the CPU
+#      arithmetic; this captures the TPU rates).  profile_overlap_r8.md:
+#      trace two short perm-backend train windows (overlap off vs 1step;
+#      the perm kernel double-buffers its flag-row window DMA by default)
+#      and parse executed kernels for the comm/comp overlap fraction —
+#      the hardware answer to whether the dbuf window prefetch holds the
+#      >=90% target the trace fixtures pin at 95% (acceptance floor 75%).
+timeout -k 30 900 python bench.py --elision-grid-steps 120 \
+    --journal "$OBS_JOURNAL" | tail -n 1 > benchmarks/elision_r8.json
+rm -rf benchmarks/trace_r8_off benchmarks/trace_r8_1step
+for ov in off 1step; do
+    timeout -k 30 420 python train_tpu.py --name "permdbuf-$ov" \
+        --model mlp --dataset synthetic --graphid 2 --numworkers 16 \
+        --epoch 3 --backend perm --overlap "$ov" --no-comm-split \
+        --trace-dir "benchmarks/trace_r8_$ov" > /dev/null
+done
+timeout -k 10 120 python obs_tpu.py profile \
+    benchmarks/trace_r8_off benchmarks/trace_r8_1step \
+    --md benchmarks/profile_overlap_r8.md --journal "$OBS_JOURNAL" \
+    || echo "profile_overlap_r8: no device rows (CPU fallback?)"
+
 # 2. full-train-step throughput + gossip marginal at the north-star config
 #    (--remat + slab 32: the un-rematted 256x32 backward over-allocates v5e
 #    HBM).  Generous bound: the program compiles are the cost; they persist

@@ -44,6 +44,11 @@
 #                    pytest (marker: perm); then the probe's --smoke
 #                    interpret-mode A/B — the production perm kernel must
 #                    reproduce the fused W-stack kernel in f32
+#  11.6 dbuf smoke  double-buffering is latency-only (ISSUE 19): the
+#                    cost ledger's perm streamed boundary bytes must be
+#                    IDENTICAL with dbuf on and off, and the profile
+#                    renderer must reproduce the pinned 95.0% overlap on
+#                    the dbuf trace fixture (>75% acceptance floor)
 #  12. attribution smoke  obs_tpu.py timeline must validate + round-trip
 #                    the committed reference journal, and obs_tpu.py
 #                    attribute must exit NON-zero on it (its real comm
@@ -174,6 +179,29 @@ PERM_OUT="$(JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python \
     benchmarks/perm_probe.py --smoke --reps 1)" || rc=1
 grep -q '"valid": true' <<<"$PERM_OUT" || { \
     echo "perm smoke: correctness gate FAILED: $PERM_OUT"; rc=1; }
+
+echo "== dbuf smoke (bytes invariance + pinned fixture overlap) =="
+# double-buffering moves the flag-row window DMA earlier; it must not
+# change WHAT is streamed — the ledger's boundary-byte keys are equal
+# dbuf on/off or the kernel is doing different work, not the same work
+# sooner
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY' || rc=1
+from matcha_tpu import topology as tp
+from matcha_tpu.obs.costs import gossip_chain_costs
+
+dec = tp.select_graph(0)
+on = gossip_chain_costs(8, 512, dec, t_steps=24, dbuf=True)
+off = gossip_chain_costs(8, 512, dec, t_steps=24, dbuf=False)
+for key in ("hbm_bytes", "hbm_bytes_per_step", "arg_bytes", "out_bytes",
+            "stream_hbm_bytes_per_step"):
+    assert on[key] == off[key], (key, on[key], off[key])
+PY
+# the profile renderer on the dbuf trace fixture must reproduce the
+# pinned 95.0% overlap (acceptance floor is >75%)
+PROFILE_OUT="$(JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python obs_tpu.py \
+    profile tests/fixtures/trace_overlap_1step_dbuf.trace.json.gz)" || rc=1
+grep -q '95.0%' <<<"$PROFILE_OUT" || { \
+    echo "dbuf smoke: pinned overlap not reproduced: $PROFILE_OUT"; rc=1; }
 
 echo "== attribution + timeline smoke (committed reference journal) =="
 TRACE_OUT="$(mktemp)"

@@ -255,6 +255,64 @@ class Communicator:
                 x, lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False))
         return x, c
 
+    def run_elided(self, flat: jax.Array, flags: jax.Array,
+                   local_every, carry: Any = None, alive: Any = None,
+                   offset: int = 0):
+        """Scan the chain with universal local-step elision (DESIGN.md §24)
+        — the chain-level twin of the restructured epoch's scan body.
+
+        Step *t* executes ``step`` only when ``(t + offset) % L == 0``; a
+        thinned step takes the identity branch of a ``lax.cond`` and
+        executes *nothing* — no mixing arithmetic, no exchange, no carry
+        advance — instead of multiplying by the identity ``W`` a zeroed
+        flag row builds.  ``local_every`` may be a python int or a traced
+        ``i32[]`` (the hot-swappable ``serve.ControlKnobs`` knob): the
+        predicate is a traced value either way, so one compiled program
+        serves every cadence.  Equivalence contract (pinned by
+        ``tests/test_overlap.py``): on a flag stream whose thinned rows
+        are zero, ``run_elided == run`` on every backend — an all-zero row
+        is identity mixing, so skipping it is exact (up to the carry of a
+        *compressing* communicator, which no longer pays quantization on
+        steps that exchange nothing — local steps mean no wire touch at
+        all).  ``offset`` aligns the cursor mid-stream (an epoch slice
+        starting at global step s passes ``offset=s``)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if carry is None:
+            carry = self.init(flat)
+        flags = jnp.asarray(flags, jnp.float32)
+        if flags.shape[0] == 0:
+            return flat, carry
+        every = jnp.maximum(jnp.asarray(local_every, jnp.int32), 1)
+        if alive is not None:
+            alive = jnp.asarray(alive, jnp.float32)
+
+        def body(state, xs):
+            x, c, t = state
+            flags_t, alive_t = xs
+
+            def mix(xx, cc):
+                if alive_t is None:
+                    return self.step(xx, cc, flags_t)
+                return self.step(xx, cc, flags_t, alive_t)
+
+            x, c = lax.cond(lax.rem(t, every) == 0, mix,
+                            lambda xx, cc: (xx, cc), x, c)
+            return (x, c, t + 1), None
+
+        t0 = jnp.asarray(int(offset), jnp.int32)
+        if alive is None or alive.ndim == 1:
+            a = alive  # None or constant row: closed over, not scanned
+
+            def body_const(state, flags_t):
+                return body(state, (flags_t, a))
+
+            (x, c, _), _ = lax.scan(body_const, (flat, carry, t0), flags)
+            return x, c
+        (x, c, _), _ = lax.scan(body, (flat, carry, t0), (flags, alive))
+        return x, c
+
     def run(self, flat: jax.Array, flags: jax.Array, carry: Any = None,
             alive: Any = None):
         """Scan the communicator over a whole flag stream (consensus-only runs,

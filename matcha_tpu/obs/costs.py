@@ -46,7 +46,8 @@ import numpy as np
 __all__ = ["ChipSpec", "CHIP_PEAKS", "CPU_PROVISIONAL", "chip_peaks",
            "resolve_chip", "abstract_args", "program_fingerprint",
            "analyze_program", "CostLedger", "Roofline", "gossip_step_costs",
-           "gossip_chain_costs", "flat_param_dim", "roofline_report",
+           "gossip_chain_costs", "elision_epoch_costs", "flat_param_dim",
+           "roofline_report",
            "roofline_compare", "capacity_report",
            "render_roofline_markdown", "render_roofline_compare_markdown",
            "render_capacity_markdown"]
@@ -354,7 +355,8 @@ def gossip_step_costs(n: int, dim: int, decomposed: Sequence[Sequence[tuple]],
 
 def gossip_chain_costs(n: int, dim: int, decomposed,
                        backend: str = "fused", wire_dtype: str = "bf16",
-                       t_steps: int = 200, block_d: int = 2048) -> Dict:
+                       t_steps: int = 200, block_d: int = 2048,
+                       dbuf: bool = True) -> Dict:
     """Extracted per-step costs of a T-step *chain* program — the fused
     W-stack kernel or the permutation-form flag-stream kernel, amortized
     over its ``t_steps`` (the regime both kernels exist for: the state is
@@ -420,9 +422,12 @@ def gossip_chain_costs(n: int, dim: int, decomposed,
         # the lambda's table params shadow the validated pi/pr on purpose:
         # they are exactly what analyze_program passes, and the GL101 seam
         # check resolves the names to the involution_tables binding above
+        # dbuf toggles the kernel's DMA schedule only (manual double-
+        # buffered window copies vs streamed BlockSpec) — ci/lint.sh pins
+        # that every byte figure here is invariant to it
         fn = jax.jit(lambda xx, ww, pi, pr: perm_gossip_run(
             xx, ww, pi, pr, block_d=block_d, wire_dtype=wd,
-            interpret=interpret))
+            interpret=interpret, dbuf=dbuf))
         costs = analyze_program(
             fn, x, w, pi, pr, label=f"gossip_chain_perm_{wire_dtype}")
         # boundary stream: M·4 of flag row per step + the two [M, N]
@@ -448,6 +453,66 @@ def gossip_chain_costs(n: int, dim: int, decomposed,
         "model_flops": model_flops,
     }
     return {**costs, **per_step}
+
+
+def elision_epoch_costs(n: int, dim: int, decomposed,
+                        backend: str = "dense", wire_dtype: str = "bf16",
+                        t_steps: int = 200, local_every: int = 1,
+                        block_d: int = 2048) -> Dict:
+    """Per-epoch gossip-attributed HBM boundary bytes under local-step
+    elision (DESIGN.md §24) — the ledger's statement of what universal
+    elision removes.
+
+    With ``local_every = L``, the restructured epoch *executes* the mix
+    only on steps with ``t % L == 0`` — ``ceil(T/L)`` of ``T`` — and the
+    thinned steps' gossip programs never run, so their boundary traffic
+    vanishes rather than being multiplied by an identity.  This function
+    prices exactly that executed set:
+
+    - ``dense``: the per-step ``W_t @ x`` program's boundary ``hbm_bytes``
+      (:func:`gossip_step_costs` — state in+out and the flag row, each a
+      real program boundary every executed step) × executed steps.
+    - ``fused`` / ``perm``: one chain program over the executed steps
+      (:func:`gossip_chain_costs` at ``t_steps = ceil(T/L)``), minus the
+      one-time state read+write both an L=1 and an L=4 epoch pay once —
+      i.e. the *streamed operand* bytes, the term elision actually thins
+      (W-stack rows for fused, flag rows + amortized tables for perm).
+
+    Returns the underlying program costs plus ``exec_steps``,
+    ``gossip_hbm_bytes_per_epoch``, and ``gossip_hbm_bytes_per_step``
+    (per *scheduled* step, ÷T — the number steps/s improvements track).
+    The ≥2× L=1→L=4 reduction acceptance pin lives in
+    ``tests/test_overlap.py``; ``bench.py --suite elision_grid`` records
+    the same quantity next to measured steps/s.
+    """
+    local_every = max(int(local_every), 1)
+    t_steps = int(t_steps)
+    if t_steps < 1:
+        raise ValueError(f"t_steps must be >= 1, got {t_steps}")
+    exec_steps = -(-t_steps // local_every)  # ceil: t=0 always mixes
+    if backend in ("dense", "skip"):
+        # skip shares dense's per-executed-step program — its thinning
+        # already happened at the flag level, so the executed set is the
+        # same program either way
+        costs = gossip_step_costs(n, dim, decomposed, wire_dtype=wire_dtype)
+        per_epoch = costs["hbm_bytes"] * exec_steps
+    elif backend in ("fused", "perm"):
+        costs = gossip_chain_costs(
+            n, dim, decomposed, backend=backend, wire_dtype=wire_dtype,
+            t_steps=exec_steps, block_d=block_d)
+        per_epoch = costs["stream_hbm_bytes_per_step"] * exec_steps
+    else:
+        raise ValueError(
+            f"unknown elision backend {backend!r} (dense|skip|fused|perm)")
+    return {
+        **costs,
+        "backend": backend,
+        "t_steps": t_steps,
+        "local_every": local_every,
+        "exec_steps": exec_steps,
+        "gossip_hbm_bytes_per_epoch": float(per_epoch),
+        "gossip_hbm_bytes_per_step": float(per_epoch) / t_steps,
+    }
 
 
 @dataclasses.dataclass(frozen=True)

@@ -363,6 +363,24 @@ def compare_sources(sources: Sequence[str]) -> Tuple[List[Dict], List[str]]:
                 })
         except (OSError, ValueError, KeyError) as e:
             problems.append(f"{src}: {type(e).__name__}: {e}")
+    # completeness (ISSUE 19): the committed bench trajectory sat at repo
+    # root for five rounds while the compare table stayed empty of it —
+    # whenever any BENCH_r*.json is compared, every sibling BENCH_r*.json
+    # in its directory must land in the table too, or the omission is
+    # named in the rendered output instead of silently shrinking history
+    import glob as _glob
+    import re as _re
+
+    bench_dirs = sorted({
+        os.path.dirname(os.path.abspath(s)) for s in sources
+        if _re.fullmatch(r"BENCH_r\d+\.json", os.path.basename(s))})
+    given = {os.path.abspath(s) for s in sources}
+    for d in bench_dirs:
+        for sib in sorted(_glob.glob(os.path.join(d, "BENCH_r*.json"))):
+            if os.path.abspath(sib) not in given:
+                problems.append(
+                    f"missing from table: {os.path.basename(sib)} (sits "
+                    f"next to a compared BENCH record in {d})")
     return rows, problems
 
 
@@ -384,5 +402,9 @@ def render_compare(rows: List[Dict], problems: List[str],
             lines.append(" ".join(_fmt(r.get(c)).ljust(widths[c])
                                   for c in cols))
     for p in problems:
-        lines.append(f"# unreadable: {p}")
+        # completeness misses carry their own verb; read failures keep
+        # the historical "unreadable" tag
+        prefix = "# " if p.startswith("missing from table:") \
+            else "# unreadable: "
+        lines.append(prefix + p)
     return "\n".join(lines)

@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .gossip import mxu_precision, resolve_wire_dtype
 
@@ -298,36 +299,93 @@ def _make_perm_kernel(w_window: int, num_matchings: int, wire):
             o_ref[...] = x_ref[...]
 
         w_win = w_ref[...]  # [w_window, M] — one tiny read per visit
+        _perm_window_body(o_ref, w_win, pi_ref, gate_ref, w_window,
+                          num_matchings, wire)
 
-        def step(k, carry):
-            cur = o_ref[...]
-            curf = cur.astype(jnp.float32)
-            # wire image: quantized ONCE per step, read by both gather
-            # endpoints — edge-pairwise cancellation (exact worker-mean
-            # preservation) survives the narrow wire, same proof as
-            # gossip_mix.  f32 wire keeps the state untouched.
-            xw = curf if wire is None else cur.astype(wire).astype(jnp.float32)
-            acc = jnp.zeros_like(curf)
-            for j in range(num_matchings):
-                # the row gather is the matching exchange: partner rows of
-                # this static involution, VMEM-local sublane movement
-                delta = jnp.take(xw, pi_ref[j], axis=0) - xw
-                acc = acc + (w_win[k, j] * gate_ref[j])[:, None] * delta
-            o_ref[...] = (curf + acc).astype(o_ref.dtype)
-            return carry
+    return _kernel
 
-        # fori_loop, not a python unroll: the step body is identical per k
-        # (only the dynamic weight-row index moves), and unrolling it made
-        # interpret-mode compile time blow up superlinearly past ~5 steps
-        # — a w_window=8 window cost 38 s of XLA CPU compile unrolled,
-        # <2 s looped, with the loop trip count a trace-time constant
-        jax.lax.fori_loop(0, w_window, step, 0)
+
+def _perm_window_body(o_ref, w_win, pi_ref, gate_ref, w_window,
+                      num_matchings, wire):
+    """The shared per-window step loop of both perm kernels — ``w_win``
+    (``[w_window, M]``) is the only thing the buffering strategy changes,
+    so factoring the arithmetic out is what makes the double-buffered
+    kernel *bitwise* the streamed one by construction."""
+
+    def step(k, carry):
+        cur = o_ref[...]
+        curf = cur.astype(jnp.float32)
+        # wire image: quantized ONCE per step, read by both gather
+        # endpoints — edge-pairwise cancellation (exact worker-mean
+        # preservation) survives the narrow wire, same proof as
+        # gossip_mix.  f32 wire keeps the state untouched.
+        xw = curf if wire is None else cur.astype(wire).astype(jnp.float32)
+        acc = jnp.zeros_like(curf)
+        for j in range(num_matchings):
+            # the row gather is the matching exchange: partner rows of
+            # this static involution, VMEM-local sublane movement
+            delta = jnp.take(xw, pi_ref[j], axis=0) - xw
+            acc = acc + (w_win[k, j] * gate_ref[j])[:, None] * delta
+        o_ref[...] = (curf + acc).astype(o_ref.dtype)
+        return carry
+
+    # fori_loop, not a python unroll: the step body is identical per k
+    # (only the dynamic weight-row index moves), and unrolling it made
+    # interpret-mode compile time blow up superlinearly past ~5 steps
+    # — a w_window=8 window cost 38 s of XLA CPU compile unrolled,
+    # <2 s looped, with the loop trip count a trace-time constant
+    jax.lax.fori_loop(0, w_window, step, 0)
+
+
+def _make_perm_kernel_dbuf(w_window: int, num_matchings: int, wire):
+    """Double-buffered kernel body (DESIGN.md §24): the ``[T, M]`` flag
+    stream stays in HBM (``memory_space=ANY``) and the kernel owns its
+    window DMAs through a 2-slot VMEM scratch — window ``t+1``'s async
+    copy is *started* before window ``t``'s gathers run and waited only
+    when its data is needed, so the flag-row stream rides under the VPU
+    row gathers instead of serializing with them (the Pallas
+    multiple-buffering pattern).  Same bytes, same arithmetic — only the
+    schedule changes: the streamed-BlockSpec form makes the grid's
+    implicit window fetch a dependency of the whole visit, while here the
+    only consumer of the copy is the ``.wait()`` directly before the
+    window body.
+    """
+
+    def _kernel(x_ref, w_hbm, pi_ref, gate_ref, o_ref, w_buf, sem):
+        t = pl.program_id(1)
+        nt = pl.num_programs(1)
+
+        def window_copy(win, slot):
+            return pltpu.make_async_copy(
+                w_hbm.at[pl.ds(win * w_window, w_window)],
+                w_buf.at[slot], sem.at[slot])
+
+        @pl.when(t == 0)
+        def _():
+            # first visit of this D-block: seed the output and warm the
+            # pipeline with window 0's copy (slot 0)
+            o_ref[...] = x_ref[...]
+            window_copy(0, 0).start()
+
+        slot = jax.lax.rem(t, 2)
+
+        @pl.when(t + 1 < nt)
+        def _():
+            # overlap: next window's flag rows start flowing before this
+            # window's gathers — its slot was fully consumed at t−1, so
+            # the overwrite cannot race a reader
+            window_copy(t + 1, jax.lax.rem(t + 1, 2)).start()
+
+        window_copy(t, slot).wait()
+        _perm_window_body(o_ref, w_buf[slot], pi_ref, gate_ref, w_window,
+                          num_matchings, wire)
 
     return _kernel
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_d", "w_window", "wire_dtype", "interpret"))
+    jax.jit,
+    static_argnames=("block_d", "w_window", "wire_dtype", "interpret", "dbuf"))
 def perm_gossip_run(
     x: jax.Array,
     weights: jax.Array,
@@ -339,6 +397,7 @@ def perm_gossip_run(
     w_window: int = 1,
     wire_dtype=None,
     interpret: bool = False,
+    dbuf: bool = True,
 ) -> jax.Array:
     """Apply ``T`` gossip steps in permutation form, streaming only weights.
 
@@ -375,6 +434,17 @@ def perm_gossip_run(
     same chain — and compile time stays flat instead of blowing up with
     an unrolled body.
     ``interpret=True`` runs the Pallas interpreter — the CPU tier-1 path.
+
+    ``dbuf`` (default on) double-buffers the weight-window stream
+    (DESIGN.md §24): the ``[T, M]`` flag rows stay in HBM
+    (``memory_space=ANY``) and the kernel issues its own async window
+    copies into a 2-slot VMEM scratch, starting window ``t+1``'s DMA
+    before window ``t``'s gathers so the only per-step HBM traffic rides
+    under the VPU work.  Bytes moved and arithmetic are identical to the
+    streamed-BlockSpec form — the window body is literally the same
+    function — so parity with the gather oracle is preserved bitwise and
+    ``gossip_chain_costs``'s extracted streamed bytes per step are
+    unchanged (pinned by ``ci/lint.sh``); only the DMA schedule differs.
 
     Parity contract (pinned by ``tests/test_perm_backend.py``): bitwise
     equal in f32 — masked or not, any wire — to a *compiled* ``lax.scan``
@@ -416,16 +486,31 @@ def perm_gossip_run(
         # upstream (resilience.runtime.gossip_quarantined)
         gate = gate * av[None, :] * av[jnp.asarray(perms)]
     grid = (pl.cdiv(d, block_d), (t_steps + pad) // w_window)
+    if dbuf:
+        # manual double-buffered weight stream: whole [T, M] stack stays
+        # in HBM, the kernel owns the window DMAs (2-slot scratch + DMA
+        # semaphore pair)
+        kernel = _make_perm_kernel_dbuf(w_window, m, wire)
+        w_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+        scratch = [
+            pltpu.VMEM((2, w_window, m), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+    else:
+        kernel = _make_perm_kernel(w_window, m, wire)
+        w_spec = pl.BlockSpec((w_window, m), lambda i, t: (t, 0))
+        scratch = []
     return pl.pallas_call(
-        _make_perm_kernel(w_window, m, wire),
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((n, block_d), lambda i, t: (0, i)),
-            pl.BlockSpec((w_window, m), lambda i, t: (t, 0)),
+            w_spec,
             pl.BlockSpec((m, n), lambda i, t: (0, 0)),
             pl.BlockSpec((m, n), lambda i, t: (0, 0)),
         ],
         out_specs=pl.BlockSpec((n, block_d), lambda i, t: (0, i)),
         out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(x, weights, jnp.asarray(perms, jnp.int32), gate)

@@ -56,6 +56,7 @@ from .spectral import (
     degraded_contraction_rho,
     degraded_solver_inputs,
     empirical_contraction_rate,
+    local_step_breakeven,
     masked_laplacian_expectation,
     normalize_staleness,
     parse_staleness_spec,
@@ -92,6 +93,7 @@ __all__ = [
     "load_measured_vs_ceiling",
     "load_plan",
     "load_recorder_disagreement",
+    "local_step_breakeven",
     "matching_comm_units",
     "normalize_staleness",
     "parse_staleness_spec",

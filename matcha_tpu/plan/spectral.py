@@ -41,6 +41,7 @@ __all__ = [
     "ConsensusSim",
     "simulate_consensus",
     "empirical_contraction_rate",
+    "local_step_breakeven",
     "steps_to_consensus",
     "masked_consensus_error",
     "masked_laplacian_expectation",
@@ -692,3 +693,55 @@ def steps_to_consensus(rho: float, target: float = 1e-3) -> float:
     if rho <= 0.0:
         return 1.0  # one step annihilates the consensus error (complete graph)
     return math.log(target) / math.log(rho)
+
+
+def local_step_breakeven(rho: float, t_steps: int, target: float = 1e-3,
+                         step_time_s: float | None = None,
+                         gossip_time_s: float | None = None) -> dict:
+    """When does local-step elision win? (DESIGN.md §24.)
+
+    Thinning gossip to every L-th step makes each *scheduled* step
+    contract by only ``ρ^(1/L)`` on average (PR 14's staleness theory —
+    the thinned chain telescopes exactly), so over a fixed training
+    horizon of ``t_steps`` SGD steps the consensus error bound is
+    ``ρ^(t_steps/L)·e₀``.  Elision wins exactly when the gossip budget
+    was *overprovisioned*: consensus still reaches ``target`` inside the
+    horizon at L > 1, and every elided step stops paying the mix.  The
+    largest such period is
+
+        ``max_local_every = t_steps / steps_to_consensus(ρ, target)``
+
+    (∞ when ρ ≤ 0, 0 when ρ ≥ 1 — no L keeps a non-contracting chain
+    under target).  Given per-step times, the wall-clock speedup of
+    running at period L is ``(c + g) / (c + g/L)`` — the universal-
+    elision executor actually realizes the ``g/L`` term because thinned
+    steps skip the mix program instead of multiplying by identity
+    (``obs.costs.elision_epoch_costs`` prices the removed bytes).
+
+    Returns ``{"max_local_every", "steps_needed", "speedup_at_max"}``;
+    ``speedup_at_max`` is None unless both times are given (then computed
+    at ``floor(max_local_every)`` clamped ≥ 1).
+    """
+    if t_steps < 1:
+        raise ValueError(f"t_steps must be >= 1, got {t_steps}")
+    needed = steps_to_consensus(rho, target)
+    if needed == math.inf:
+        max_l = 0.0
+    elif needed <= 0:
+        max_l = math.inf
+    else:
+        max_l = float(t_steps) / needed
+    speedup = None
+    if step_time_s is not None and gossip_time_s is not None:
+        if step_time_s < 0 or gossip_time_s < 0:
+            raise ValueError("step_time_s and gossip_time_s must be >= 0")
+        l_int = max(int(max_l), 1) if max_l not in (0.0, math.inf) \
+            else (1 if max_l == 0.0 else max(t_steps, 1))
+        total = step_time_s + gossip_time_s
+        speedup = total / (step_time_s + gossip_time_s / l_int) \
+            if total > 0 else 1.0
+    return {
+        "max_local_every": max_l,
+        "steps_needed": needed,
+        "speedup_at_max": speedup,
+    }

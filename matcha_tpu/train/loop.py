@@ -186,11 +186,13 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None,
     run_flags = (np.asarray(schedule.flags, np.float32) * faults.link_up
                  if faults is not None else schedule.flags)
     if config.local_steps > 1 and boundary_hook is None:
-        # local SGD steps (DESIGN.md §20): gossip fires only every L-th
-        # step.  Static thinning of the flag stream — an all-zero flag row
-        # is identity mixing on every backend and moves zero wire bytes,
-        # so the communicators, telemetry, and the comm-split timer need
-        # no extra machinery (the same trick link outages ride above).
+        # local SGD steps (DESIGN.md §20, §24): gossip fires only every
+        # L-th step.  Static thinning of the flag stream keeps telemetry
+        # and the comm-split timer honest (a zero row counts zero wire
+        # bytes), and the step itself now *elides* thinned steps — the
+        # gossip call compiles inside a lax.cond keyed on the step cursor
+        # (make_train_step's local_steps), so dense/perm/fused stop
+        # executing the identity mix instead of multiplying by it.
         # The schedule fingerprint stays the as-built stream: thinning is
         # config-derived, so a resume re-derives it identically.
         keep = (np.arange(len(run_flags)) % config.local_steps
@@ -460,6 +462,7 @@ def train(config: TrainConfig, resume_dir: Optional[str] = None,
             stale_alpha_scale=stale_scale, telemetry=tel_spec,
             elastic=elastic_ctl is not None,
             control=control_knobs is not None,
+            local_steps=config.local_steps,
         )
 
     step_fn = None  # populated by _build_programs() below
@@ -1486,7 +1489,18 @@ def _make_epoch_scan(step_fn):
     # donate_argnums: the state (params + optimizer moments + CHOCO carry,
     # replicated N ways) is the dominant persistent buffer at 256 workers —
     # donation lets XLA write the output state into the input's memory
-    # instead of double-buffering it
+    # instead of double-buffering it.
+    #
+    # The scan body IS the restructured epoch of DESIGN.md §24: under
+    # local-step elision the step_fn compiles the gossip call inside a
+    # lax.cond keyed on the traced step cursor, so the one scanned program
+    # executes fwd/bwd+SGD every body and the mix only in every L-th body.
+    # A cond inside the body was chosen over a literal two-level
+    # scan-of-fori_loop on purpose: group boundaries shift when the
+    # local_every knob hot-swaps mid-run (and when bpe % L != 0 across
+    # chunked epochs), and the cond form keeps ONE program shape through
+    # every such change — the zero-retrace contract — while eliding
+    # exactly the same work.
     @functools.partial(jax.jit, donate_argnums=(0,))
     def scan_step(state, xs, ys, rng):
         def body(s, batch):

@@ -180,6 +180,7 @@ def make_train_step(
     telemetry=None,
     elastic: bool = False,
     control: bool = False,
+    local_steps: int = 1,
 ):
     """Build ``step(state, xb, yb[, rng]) -> (state, metrics)``.
 
@@ -272,9 +273,24 @@ def make_train_step(
     re-solved probabilities, first-moment-exact), ``alpha_scale`` executes
     a re-derived α exactly (the same α·flag_j algebra elastic uses — the
     two compose by multiplication), and ``local_every`` thins gossip to
-    every k-th step with an in-graph cursor gate.  All value updates at
-    epoch boundaries, shapes pinned — the zero-retrace contract.  ``False``
-    (or an empty slot) compiles the exact pre-serve program.
+    every k-th step.  All value updates at epoch boundaries, shapes pinned
+    — the zero-retrace contract.  ``False`` (or an empty slot) compiles
+    the exact pre-serve program.
+
+    ``local_steps`` (L ≥ 1): universal local-step elision (DESIGN.md §24).
+    When L > 1 — or whenever ``control`` is live (the traced
+    ``local_every`` knob may be hot-swapped above 1 at any boundary) — the
+    gossip call compiles inside a ``lax.cond`` keyed on the step cursor:
+    thinned steps (``step % L != 0``) take the identity branch and
+    *execute nothing* — no MXU ``W_t @ x``, no Pallas gathers, no wire
+    bytes — instead of multiplying by an identity ``W``.  The predicate is
+    a traced value (static L or the ``local_every`` knob), so hot-swaps
+    never retrace, and at L = 1 with no controller the cond is omitted
+    entirely: the exact pre-elision program compiles bitwise.  Overlap
+    semantics are preserved: ``apply_mix``/ring consumption stay
+    unconditional (a thinned step parks a zero delta, so the consume is a
+    no-op add exactly as the zero-weight path produced), only the *issue*
+    — the expensive exchange — is elided.
     """
     flags_arr = jnp.asarray(np.asarray(flags), jnp.float32)  # [T, M]
     n_workers = flattener.num_workers
@@ -291,6 +307,15 @@ def make_train_step(
     if not stale_alpha_scale > 0:
         raise ValueError(f"stale_alpha_scale must be > 0, got "
                          f"{stale_alpha_scale}")
+    local_steps = int(local_steps)
+    if local_steps < 1:
+        raise ValueError(f"local_steps must be >= 1, got {local_steps}")
+    # universal local-step elision (DESIGN.md §24): the gossip issue is
+    # wrapped in a lax.cond whenever thinned steps can exist — statically
+    # (local_steps > 1) or dynamically (a live controller may hot-swap
+    # local_every above 1).  L = 1 without a controller compiles the exact
+    # pre-elision program: no cond, bitwise unchanged.
+    elide = control or local_steps > 1
     # the α damping is a trace-time constant scale on the communicator's
     # flag row (every backend's edge weight is α·flag_j); telemetry keeps
     # reading the unscaled flags_arr — the schedule still fires
@@ -377,17 +402,22 @@ def make_train_step(
             comm_flags_t = comm_flags_arr[t] * state.membership.alpha_scale
         # run-controller knobs (DESIGN.md §22): pure multiplicative
         # re-weighting of the flag row — per-matching row_scale (budget
-        # re-solve), α′/α (mixing-weight re-derivation), and the
-        # local-step gate (gossip every k-th step).  Composes with the
-        # elastic α scale above; shapes never change, so every hot-swap
-        # reuses this compiled program verbatim.
+        # re-solve) and α′/α (mixing-weight re-derivation).  Composes with
+        # the elastic α scale above; shapes never change, so every hot-swap
+        # reuses this compiled program verbatim.  The local-step cadence is
+        # deliberately NOT a zero-weight multiply anymore: it decides the
+        # traced `do_mix` predicate below, and thinned steps skip the
+        # gossip computation entirely (universal elision, DESIGN.md §24).
+        local_every_t = None
         if control and not isinstance(state.control, tuple):
             knobs = state.control
-            local_gate = (jax.lax.rem(
-                state.step, jnp.maximum(knobs.local_every, 1)) == 0
-            ).astype(jnp.float32)
-            comm_flags_t = (comm_flags_t * knobs.row_scale
-                            * knobs.alpha_scale * local_gate)
+            comm_flags_t = comm_flags_t * knobs.row_scale * knobs.alpha_scale
+            local_every_t = jnp.maximum(knobs.local_every, 1)
+        elif elide:
+            local_every_t = jnp.asarray(np.int32(local_steps))
+        do_mix = None
+        if local_every_t is not None:
+            do_mix = jax.lax.rem(state.step, local_every_t) == 0
         alive = None
         if faults is not None or member is not None:
             from ..resilience.runtime import (
@@ -454,18 +484,31 @@ def make_train_step(
             flat = communicator.apply_mix(
                 flat, jax.lax.dynamic_index_in_dim(
                     mix_pending, slot, 1, keepdims=False))
-            if alive is None:
-                delta, carry = communicator.begin_mix(
-                    flat, comm_carry, comm_flags_t)
-                issued = jnp.zeros((n,), jnp.int32)
-            else:
-                delta, carry = begin_mix_quarantined(
-                    communicator.begin_mix, flat, comm_carry, comm_flags_t,
+
+            def _ring_issue(f, c):
+                if alive is None:
+                    d, c2 = communicator.begin_mix(f, c, comm_flags_t)
+                    return d, c2, jnp.zeros((n,), jnp.int32)
+                d, c2 = begin_mix_quarantined(
+                    communicator.begin_mix, f, c, comm_flags_t,
                     alive, gate=row_finite)
                 # dead/non-finite rows issued nothing real (their delta
                 # rows are zeroed above): their slot entries stay empty
-                issued = jnp.where((alive > 0) & (row_finite > 0),
-                                   0, -1).astype(jnp.int32)
+                return d, c2, jnp.where((alive > 0) & (row_finite > 0),
+                                        0, -1).astype(jnp.int32)
+
+            if do_mix is None:
+                delta, carry, issued = _ring_issue(flat, comm_carry)
+            else:
+                # elided step: park a zero delta with the slot marked
+                # empty (−1) — the consume at t+K is then a no-op add,
+                # exactly what the zero-weight issue used to park, but
+                # without executing the exchange
+                delta, carry, issued = jax.lax.cond(
+                    do_mix, _ring_issue,
+                    lambda f, c: (jnp.zeros_like(f), c,
+                                  jnp.full((n,), -1, jnp.int32)),
+                    flat, comm_carry)
             mix_pending = jax.lax.dynamic_update_index_in_dim(
                 mix_pending, delta, slot, 1)
             mix_ages = jax.lax.dynamic_update_index_in_dim(
@@ -476,22 +519,40 @@ def make_train_step(
             # its collectives have no consumer until step t+1's apply, so
             # they are free to run under the next forward/backward
             flat = communicator.apply_mix(flat, mix_pending)
-            if alive is None:
-                mix_pending, carry = communicator.begin_mix(
-                    flat, comm_carry, comm_flags_t)
-            else:
-                mix_pending, carry = begin_mix_quarantined(
-                    communicator.begin_mix, flat, comm_carry, comm_flags_t,
+
+            def _issue(f, c):
+                if alive is None:
+                    return communicator.begin_mix(f, c, comm_flags_t)
+                return begin_mix_quarantined(
+                    communicator.begin_mix, f, c, comm_flags_t,
                     alive, gate=row_finite)
-        elif alive is None:
-            with device_span("comm/step"):
-                flat, carry = communicator.step(flat, comm_carry,
-                                                comm_flags_t)
+
+            if do_mix is None:
+                mix_pending, carry = _issue(flat, comm_carry)
+            else:
+                # elided step: nothing goes in flight (zero pending), the
+                # next step's apply is a no-op add — the consume side
+                # stays unconditional so a real delta issued at a mix
+                # step is still applied exactly one step later
+                mix_pending, carry = jax.lax.cond(
+                    do_mix, _issue,
+                    lambda f, c: (jnp.zeros_like(f), c),
+                    flat, comm_carry)
         else:
-            with device_span("comm/step"):
-                flat, carry = gossip_quarantined(
-                    communicator.step, flat, comm_carry, comm_flags_t, alive,
+            def _eager_mix(f, c):
+                if alive is None:
+                    return communicator.step(f, c, comm_flags_t)
+                return gossip_quarantined(
+                    communicator.step, f, c, comm_flags_t, alive,
                     gate=row_finite)
+
+            with device_span("comm/step"):
+                if do_mix is None:
+                    flat, carry = _eager_mix(flat, comm_carry)
+                else:
+                    flat, carry = jax.lax.cond(
+                        do_mix, _eager_mix, lambda f, c: (f, c),
+                        flat, comm_carry)
         params = flattener.unflatten(flat)
         if member is not None:
             # vacant slots are frozen at their leave-time values: the SPMD
@@ -563,10 +624,17 @@ def make_train_step(
             # trace-time (the pytree shape is static), so a run without the
             # telemetry slot compiles the exact pre-observability program
             heal_count = metrics.get("healed")
+            # wire accounting under elision: a thinned step exchanges
+            # nothing, so its flag row counts zero bytes.  On the static
+            # path the row is already zero (loop.py thins the stream);
+            # the gate makes the traced local_every knob account the same
+            tel_flags_t = flags_arr[t]
+            if do_mix is not None:
+                tel_flags_t = tel_flags_t * do_mix.astype(jnp.float32)
             new_tel = telemetry_step(
                 state.telemetry, telemetry,
                 disagreement=metrics["disagreement"],
-                flags_t=flags_arr[t],
+                flags_t=tel_flags_t,
                 alive_count=(metrics["alive_workers"]
                              if "alive_workers" in metrics
                              else jnp.asarray(np.float32(n))),

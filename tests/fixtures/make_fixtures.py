@@ -75,6 +75,10 @@ def make_trace_fixtures(root: str) -> None:
     * ``trace_overlap_1step`` — pipelined: comm rows ride a second device
       stream, 300 of every 400 µs under the next compute block → overlap
       fraction 0.75.
+    * ``trace_overlap_1step_dbuf`` — pipelined + double-buffered perm
+      kernel (ISSUE 19): the flag-window DMAs no longer serialize against
+      the row gathers, so each comm row sits almost entirely under its
+      step's compute block — 380 of every 400 µs → overlap fraction 0.95.
 
     A host-side row whose name contains ``comm/`` is planted in both:
     host lanes prove nothing about kernel concurrency and the parser must
@@ -100,7 +104,7 @@ def make_trace_fixtures(root: str) -> None:
              "args": {"name": "XLA Ops Stream 2"}}])
     shadow = [x(1, 10, 500, 50, "$comm/step host shadow", "host")]
 
-    off, on = [], []
+    off, on, dbuf = [], [], []
     for i in range(4):
         t = 1000 + 1200 * i
         off += [x(100, 1, t, 800, "fusion.12", "matcha/fwd_bwd/dot_general"),
@@ -110,13 +114,23 @@ def make_trace_fixtures(root: str) -> None:
         on += [x(100, 1, t, 900, "fusion.12", "matcha/fwd_bwd/dot_general"),
                x(100, 2, t + 700, 400, "ppermute.4",
                  "comm/begin_mix/ppermute")]
+        # double-buffered: same 400 µs comm row, but it no longer waits on
+        # its flag-window DMA — only the final 20 µs (the last window's
+        # tail past the compute block) stick out: [t+520, t+920] vs
+        # compute [t, t+900] → 380/400 overlapped
+        dbuf += [x(100, 1, t, 900, "fusion.12", "matcha/fwd_bwd/dot_general"),
+                 x(100, 2, t + 520, 400, "ppermute.4",
+                   "comm/begin_mix/ppermute")]
     # one unattributed device row per trace: executed kernel work that
     # carries no scope still counts as compute ("other")
     off.append(x(100, 1, 6000, 100, "fusion.99", "unattributed"))
     on.append(x(100, 1, 5000, 100, "fusion.99", "unattributed"))
+    dbuf.append(x(100, 1, 5000, 100, "fusion.99", "unattributed"))
 
     for name, events in (("trace_overlap_off", host + dev + shadow + off),
-                         ("trace_overlap_1step", host + dev + shadow + on)):
+                         ("trace_overlap_1step", host + dev + shadow + on),
+                         ("trace_overlap_1step_dbuf",
+                          host + dev + shadow + dbuf)):
         path = os.path.join(root, f"{name}.trace.json.gz")
         with open(path, "wb") as raw:
             with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as f:

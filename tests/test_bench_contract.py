@@ -76,6 +76,42 @@ def test_bench_fallback_record_is_structured_and_rc_zero():
 
 
 @pytest.mark.slow
+def test_elision_grid_cells_shape_and_byte_monotonicity():
+    """The universal-elision grid (ISSUE 19) emits one cell per backend ×
+    local_every with a measured rate and the ledger's per-epoch gossip
+    bytes, and every backend's L=4 bytes are strictly below its L=1
+    bytes — the measured A/B the elision claim ships with."""
+    sys.path.insert(0, REPO)
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bench import elision_grid
+        from matcha_tpu import topology as tp
+        from matcha_tpu.schedule import matcha_schedule
+
+        n = tp.graph_size(0)
+        sched = matcha_schedule(tp.select_graph(0), n, iterations=24,
+                                budget=0.5, seed=3)
+        x = jnp.asarray(np.random.default_rng(0)
+                        .normal(size=(n, 64)).astype(np.float32))
+        cells = elision_grid(sched, x, 24, n, 64, reps=1)
+    finally:
+        sys.path.remove(REPO)
+    assert [(c["backend"], c["local_every"]) for c in cells] == [
+        ("skip", 1), ("skip", 4), ("dense", 1), ("dense", 4),
+        ("perm", 1), ("perm", 4)]
+    by_key = {(c["backend"], c["local_every"]): c for c in cells}
+    for c in cells:
+        assert c["unit"] == "gossip_steps_per_sec" and c["value"] > 0
+        assert c["hbm_bytes_per_epoch"] > 0
+    for backend in ("skip", "dense", "perm"):
+        l1 = by_key[(backend, 1)]
+        l4 = by_key[(backend, 4)]
+        assert l4["hbm_bytes_per_epoch"] < l1["hbm_bytes_per_epoch"]
+        assert l4["exec_steps"] == 6 and l1["exec_steps"] == 24
+
+
 def test_bench_worker_emits_refinements_last_line_wins():
     """The worker prints the pre-sweep record, the swept record, and the
     chunked-augmented record in order; the parent keeps the LAST complete

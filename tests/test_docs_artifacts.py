@@ -97,6 +97,31 @@ def test_round_scanner_sees_both_outcomes():
     assert any(q for _, q in prose), "no queued round artifact cited"
 
 
+def test_committed_compare_table_covers_every_bench_record():
+    """ISSUE 19 satellite: the committed compare table
+    (``benchmarks/obs_compare_r6.md``) names every repo-root
+    ``BENCH_r*.json`` — the bench trajectory sat at repo root for five
+    rounds while no committed table carried it.  The library's own
+    completeness check agrees: comparing the full set yields no
+    'missing from table' problems."""
+    from matcha_tpu.obs.report import compare_sources
+
+    table = REPO / "benchmarks" / "obs_compare_r6.md"
+    assert table.exists(), "committed compare table missing"
+    text = table.read_text()
+    records = sorted(p.name for p in REPO.glob("BENCH_r*.json"))
+    assert records, "no repo-root BENCH_r*.json — scan surface rotted?"
+    absent = [r for r in records if r not in text]
+    assert not absent, (
+        f"repo-root BENCH records missing from {table.name}: {absent} — "
+        f"regenerate with: python obs_tpu.py compare "
+        f"{' '.join(records)} --md benchmarks/obs_compare_r6.md")
+    assert "missing from table" not in text
+    rows, problems = compare_sources([str(REPO / r) for r in records])
+    assert len(rows) == len(records)
+    assert not [p for p in problems if p.startswith("missing from table")]
+
+
 def test_scanner_sees_the_committed_artifacts():
     """The guard is only meaningful if the reference pattern actually hits:
     the docs do cite committed artifacts, and those all resolve."""

@@ -952,6 +952,30 @@ def test_cli_compare_mixes_bench_records_and_journals(ring8_run, tmp_path,
     assert obs_tpu.main(["compare", str(tmp_path / "missing.jsonl")]) == 2
 
 
+def test_cli_compare_names_missing_bench_siblings(tmp_path, capsys):
+    """Completeness (ISSUE 19): comparing a strict subset of a directory's
+    BENCH_r*.json records names every omitted sibling in the output —
+    the committed trajectory can never silently shrink — and the full set
+    renders clean."""
+    import obs_tpu
+
+    for r in (1, 2, 3):
+        (tmp_path / f"BENCH_r0{r}.json").write_text(json.dumps(
+            {"metric": "gossip-steps/sec", "value": 100.0 + r,
+             "unit": "gossip_steps_per_sec", "vs_baseline": 0.02,
+             "backend": "dense"}))
+    assert obs_tpu.main(["compare", str(tmp_path / "BENCH_r01.json"),
+                         str(tmp_path / "BENCH_r03.json")]) == 0
+    out = capsys.readouterr().out
+    assert "missing from table: BENCH_r02.json" in out
+    assert "BENCH_r01.json" in out and "unreadable" not in out
+    # the complete set is clean
+    assert obs_tpu.main(
+        ["compare"] + [str(tmp_path / f"BENCH_r0{r}.json")
+                       for r in (1, 2, 3)]) == 0
+    assert "missing from table" not in capsys.readouterr().out
+
+
 def test_cli_compare_reads_multichip_records(tmp_path, capsys):
     """ISSUE 8 satellite: the MULTICHIP_r*.json dryrun stamps (in-tree
     since r1) land in the same compare table — n_devices as the value,

@@ -353,6 +353,147 @@ def test_reconcile_mix_pending_drains_delta():
                                   SIZE).mix_pending == ()
 
 
+# ---------------------------------------------------------------------------
+# Universal local-step elision (DESIGN.md §24, ISSUE 19): the restructured
+# epoch executes the mix only on every L-th step — a lax.cond identity
+# branch, not a multiply-by-identity — and `Communicator.run_elided` is the
+# chain-level twin of that scan body.  Two equivalence contracts:
+#
+# * compaction (every backend, carry included): eliding steps t % L != 0 is
+#   the same chain as running only the executed rows `flags[::L]` — elided
+#   steps execute *nothing*, so even a compressing carry (CHOCO's x̂/s) and
+#   a flag-blind reducer (centralized) agree bitwise.
+# * thinned-stream (flag-thinning backends): on a stream whose thinned rows
+#   are zeroed, `run_elided == run` — an all-zero row is identity mixing,
+#   so skipping it is exact.  This is the semantics `--local-steps` pinned
+#   before elision went universal; centralized (flag-blind) and choco
+#   (zero-row steps still advance x̂) are excluded by construction.
+# ---------------------------------------------------------------------------
+
+ELISION_L = 3
+
+
+@pytest.mark.parametrize("masked", [False, True], ids=["full", "alive-mask"])
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_run_elided_matches_compacted_chain(backend, masked):
+    """run_elided(flags, L) == run(flags[::L]) on every backend: an elided
+    step executes nothing — no arithmetic, no wire, no carry advance."""
+    comm = _make(backend)
+    alive = ALIVE if masked else None
+    x0 = _x0(d=19, seed=7)
+    flags = jnp.asarray(SCHED.flags, jnp.float32)
+    el, ce = jax.jit(lambda x: comm.run_elided(
+        x, flags, ELISION_L, alive=alive))(x0)
+    ref, cr = jax.jit(lambda x: comm.run(
+        x, flags[::ELISION_L], alive=alive))(x0)
+    np.testing.assert_allclose(np.asarray(el), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(ce),
+                    jax.tree_util.tree_leaves(cr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("wire", [None, "bf16"], ids=["f32", "bf16"])
+@pytest.mark.parametrize("masked", [False, True], ids=["full", "alive-mask"])
+@pytest.mark.parametrize("backend", ["gather", "dense", "skip", "fused"])
+def test_run_elided_matches_thinned_stream(backend, masked, wire):
+    """run_elided(full flags, L) == run(thinned flags): eliding a step is
+    exactly what multiplying by the identity a zero row builds used to be —
+    the drain-equivalence contract of the restructured epoch, on every
+    flag-thinning backend × alive mask × wire dtype."""
+    comm = _make(backend, wire)
+    alive = ALIVE if masked else None
+    x0 = _x0(d=23, seed=8)
+    flags = np.asarray(SCHED.flags, np.float32).copy()
+    thinned = flags.copy()
+    thinned[np.arange(len(thinned)) % ELISION_L != 0] = 0.0
+    el, _ = jax.jit(lambda x: comm.run_elided(
+        x, jnp.asarray(flags), ELISION_L, alive=alive))(x0)
+    ref, _ = jax.jit(lambda x: comm.run(
+        x, jnp.asarray(thinned), alive=alive))(x0)
+    np.testing.assert_allclose(np.asarray(el), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_run_elided_offset_and_traced_every():
+    """Mid-stream alignment and hot-swappability: splitting a stream at an
+    arbitrary boundary and resuming with ``offset=s`` is the same chain,
+    and ``local_every`` may arrive as a traced i32 scalar (the ControlKnobs
+    slot) without changing the result."""
+    comm = _make("gather")
+    x0 = _x0(d=11, seed=9)
+    flags = jnp.asarray(SCHED.flags, jnp.float32)
+    whole, cw = comm.run_elided(x0, flags, ELISION_L)
+    s = 4  # deliberately NOT a multiple of L: the cursor must carry over
+    x1, c1 = comm.run_elided(x0, flags[:s], ELISION_L)
+    x2, c2 = comm.run_elided(x1, flags[s:], ELISION_L, carry=c1, offset=s)
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(x2))
+    traced, _ = jax.jit(
+        lambda x, ev: comm.run_elided(x, flags, ev))(
+            x0, jnp.asarray(ELISION_L, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(traced))
+    # L=1 elides nothing: exactly the plain chain
+    all_of_it, _ = comm.run_elided(x0, flags, 1)
+    ref, _ = comm.run(x0, flags)
+    np.testing.assert_allclose(np.asarray(all_of_it), np.asarray(ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_elision_ledger_2x_reduction():
+    """Acceptance pin (ISSUE 19): for dense and perm at L=4, the compiled-
+    cost ledger's per-epoch gossip-attributed boundary bytes drop ≥2× vs
+    L=1 — the thinned steps' programs are *gone*, not multiplied by I.
+    The ratio is exactly T/ceil(T/L) for dense (every executed step pays
+    the same per-step program) and slightly under L for perm (the [M, N]
+    involution tables amortize over fewer executed steps)."""
+    from matcha_tpu.obs.costs import elision_epoch_costs
+
+    t_steps = 40
+    for backend in ("dense", "perm"):
+        c1 = elision_epoch_costs(SIZE, 1024, SCHED.decomposed,
+                                 backend=backend, t_steps=t_steps,
+                                 local_every=1)
+        c4 = elision_epoch_costs(SIZE, 1024, SCHED.decomposed,
+                                 backend=backend, t_steps=t_steps,
+                                 local_every=4)
+        assert c1["exec_steps"] == t_steps
+        assert c4["exec_steps"] == -(-t_steps // 4)
+        ratio = c1["gossip_hbm_bytes_per_epoch"] \
+            / c4["gossip_hbm_bytes_per_epoch"]
+        assert ratio >= 2.0, (backend, ratio)
+        # L=1 prices the exact unthinned chain: per-epoch == per-step × T
+        assert c1["gossip_hbm_bytes_per_epoch"] == pytest.approx(
+            c1["gossip_hbm_bytes_per_step"] * t_steps)
+
+
+@pytest.mark.parametrize("backend", ["dense", "skip"])
+def test_elided_epoch_matches_eager_chain(backend):
+    """Drain equivalence at the train-loop level: the scanned L-body epoch
+    (one compiled program, gossip under a traced cond) reaches the same
+    state as the eager per-step chain at local_steps=4 — the restructure
+    moved *where* the thinning executes, not what it computes."""
+    import dataclasses
+
+    from matcha_tpu.train import TrainConfig, train
+
+    base = TrainConfig(
+        name=f"elide-{backend}", model="mlp", dataset="synthetic",
+        dataset_kwargs={"num_train": 256, "num_test": 64},
+        num_workers=SIZE, graphid=0, budget=0.5, epochs=2, lr=0.05,
+        batch_size=16, eval_every=0, save=False, measure_comm_split=False,
+        gossip_backend=backend, local_steps=4, scan_epoch=True)
+    scanned = train(base)
+    eager = train(dataclasses.replace(base, scan_epoch=False))
+    ls, le = scanned.history[-1]["loss"], eager.history[-1]["loss"]
+    assert np.isfinite(ls) and np.isfinite(le)
+    np.testing.assert_allclose(ls, le, rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(scanned.state.params),
+                    jax.tree_util.tree_leaves(eager.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 @pytest.mark.faults
 def test_overlap_with_fault_plan():
     """Chaos × pipeline: a worker dies mid-run under overlap=1step — the

@@ -30,6 +30,7 @@ from matcha_tpu.plan import (
     expected_comm_units,
     load_plan,
     load_recorder_disagreement,
+    local_step_breakeven,
     matching_comm_units,
     plan_candidate,
     save_plan,
@@ -113,6 +114,36 @@ def test_steps_to_consensus_edge_cases():
         steps_to_consensus(0.5, 1.5)
     with pytest.raises(ValueError):
         steps_to_consensus(0.5, 0.0)
+
+
+def test_local_step_breakeven_hand_check():
+    """DESIGN.md §24 planner: max_local_every = T / steps_to_consensus(ρ).
+
+    ρ=0.5, target=0.25 needs exactly 2 gossip steps, so a 40-step horizon
+    tolerates L = 20; with c = g the wall-clock speedup at L=20 is
+    (c+g)/(c+g/20) = 2/(1+1/20).
+    """
+    out = local_step_breakeven(0.5, 40, target=0.25,
+                               step_time_s=1.0, gossip_time_s=1.0)
+    assert out["steps_needed"] == pytest.approx(2.0)
+    assert out["max_local_every"] == pytest.approx(20.0)
+    assert out["speedup_at_max"] == pytest.approx(2.0 / (1.0 + 1.0 / 20))
+    # times omitted -> no speedup estimate
+    assert local_step_breakeven(0.5, 40, target=0.25)["speedup_at_max"] is None
+    # non-contracting chain: no L keeps consensus under target
+    assert local_step_breakeven(1.0, 40)["max_local_every"] == 0.0
+    # speedup at the degenerate L=1 clamp is exactly 1 (no elision possible)
+    degen = local_step_breakeven(1.0, 40, step_time_s=1.0, gossip_time_s=1.0)
+    assert degen["speedup_at_max"] == pytest.approx(1.0)
+    # overprovisioned horizon never *hurts*: speedup_at_max >= 1 always
+    mid = local_step_breakeven(0.9, 10, target=0.5,
+                               step_time_s=1.0, gossip_time_s=0.25)
+    assert 1.0 <= mid["max_local_every"] or mid["max_local_every"] == 0.0
+    assert mid["speedup_at_max"] >= 1.0
+    with pytest.raises(ValueError):
+        local_step_breakeven(0.5, 0)
+    with pytest.raises(ValueError):
+        local_step_breakeven(0.5, 40, step_time_s=-1.0, gossip_time_s=1.0)
 
 
 # ------------------------------------------------------------- cost model

@@ -406,6 +406,47 @@ def test_hot_swap_budget_mid_run_zero_retrace(tmp_path):
     assert [e for e in events if e["kind"] == "retrace"] == []
 
 
+def test_hot_swap_local_every_single_epoch_program(tmp_path, monkeypatch):
+    """ISSUE 19 pin: a ``local_steps`` hot-swap through control.json rides
+    the traced ``local_every`` knob of the universally-elided epoch —
+    ``check_single_trace`` proves exactly ONE epoch program was ever
+    compiled across the swap (the elision cond's predicate is a value,
+    not a shape), on top of the journal's own silent retrace watch."""
+    import matcha_tpu.train.loop as loop_mod
+    from matcha_tpu.analysis import check_single_trace, retrace_guard
+
+    real = loop_mod._make_epoch_scan
+    counters = []
+
+    def spy(step_fn):
+        wrapped, counter = retrace_guard(real(step_fn))
+        counters.append(counter)
+        return wrapped
+
+    monkeypatch.setattr(loop_mod, "_make_epoch_scan", spy)
+    control = str(tmp_path / "control.json")
+    harness = TrainerHarness(_spec(tmp_path, control_path=control))
+    published = []
+
+    def hook(seam):
+        if seam.epoch == 1 and not published:
+            write_control(control, {"version": 1, "local_steps": 2})
+            published.append(True)
+        harness.on_boundary(seam)
+
+    cfg = dataclasses.replace(BASE, name="lswap", epochs=4,
+                              savePath=str(tmp_path))
+    result = train(cfg, boundary_hook=hook)
+    assert len(result.history) == 4
+    events = _journal(str(tmp_path / "lswap_mlp"))
+    controls = [e for e in events if e["kind"] == "control"]
+    assert [(e["action"], e["applied"], e["epoch"]) for e in controls] == \
+        [("apply", True, 1)]
+    assert [e for e in events if e["kind"] == "retrace"] == []
+    assert len(counters) == 1  # one epoch program built, period
+    check_single_trace(counters[0], label="epoch_scan(local_every swap)")
+
+
 def test_invalid_document_rejected_whole(tmp_path):
     """One bad field rejects everything: the valid budget half must NOT
     apply when the restart half cannot construct a config."""
