@@ -125,6 +125,7 @@ def _cached_schedule(n, steps):
     # fixed /tmp name is poisonable and os.replace over another user's file
     # raises in sticky /tmp
     from matcha_tpu.utils import user_cache_dir
+    from matcha_tpu.utils.atomicio import atomic_publish
 
     cache = os.path.join(user_cache_dir("bench"),
                          f"sched_geometric_n{n}_b0.5_s{steps}_seed0.npz")
@@ -148,14 +149,17 @@ def _cached_schedule(n, steps):
     sched = matcha_schedule(dec, n, iterations=steps, budget=0.5, seed=0)
     me = np.asarray([(m, u, v) for m, match in enumerate(dec)
                      for (u, v) in match], dtype=np.int32).reshape(-1, 3)
-    # suffix must stay ".npz" — np.savez appends it to any other name,
-    # which would make the os.replace source not exist
-    tmp = cache + f".tmp{os.getpid()}.npz"
-    np.savez(tmp, perms=np.asarray(sched.perms),
-             flags=np.asarray(sched.flags),
-             alpha=np.float64(sched.alpha), probs=np.asarray(sched.probs),
-             matching_edges=me)
-    os.replace(tmp, cache)
+    # np.savez on an open file object keeps the name as-is (it only
+    # appends ".npz" to bare path strings), so the atomic-publish seam
+    # needs no suffix workaround
+    atomic_publish(
+        cache,
+        lambda f: np.savez(f, perms=np.asarray(sched.perms),
+                           flags=np.asarray(sched.flags),
+                           alpha=np.float64(sched.alpha),
+                           probs=np.asarray(sched.probs),
+                           matching_edges=me),
+        mode="wb", prefix=".sched.")
     return sched
 
 

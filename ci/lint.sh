@@ -4,14 +4,23 @@
 # Six stages, each loud on failure; the gate fails if any stage fails:
 #
 #   1. graftlint     GL001–GL006 (syntactic) + GL101–GL104 (SPMD dataflow)
-#                    + GL201–GL203 (graftcontract) over the shipped
-#                    surface (incl. matcha_tpu/obs and obs_tpu.py), empty
-#                    baseline
+#                    + GL201–GL203 (graftcontract) + GL301–GL304
+#                    (graftdur) over the shipped surface (incl.
+#                    matcha_tpu/obs and obs_tpu.py), empty baseline
 #   1.5 graftcontract  GL201–GL203 in isolation: sync-budget prover
 #                    against the committed sync_budget.json manifest,
 #                    journal-schema call sites, checkpoint-evolution
 #                    coverage — its own loud stage so a contract break is
 #                    named as one, plus the contracts pytest lane
+#   1.6 graftdur     GL301–GL304 in isolation: atomic-publish prover
+#                    (every watched-path write through the ONE
+#                    utils.atomicio.atomic_publish seam), single-writer
+#                    journal + torn-tolerant readers, best-effort IO
+#                    inside root-marked loops, thread-shared mutation —
+#                    its own loud stage so a durability break is named as
+#                    one, plus the durability pytest lane (rule triples,
+#                    real-tree tamper suite, the seam under injected
+#                    ENOSPC, the spec-publish squatter regression)
 #   2. lint-plan     PL001–PL008 numeric verification of every committed
 #                    schedule/plan artifact under benchmarks/
 #   3. analysis lane the same engines + the dynamic retrace sanitizer +
@@ -99,6 +108,14 @@ python lint_tpu.py --rules GL201,GL202,GL203 \
 echo "== contracts pytest lane =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m contracts -p no:cacheprovider || rc=1
+
+echo "== graftdur (GL301-GL304, empty baseline) =="
+python lint_tpu.py --rules GL301,GL302,GL303,GL304 \
+    ${CHANGED_ARGS[@]+"${CHANGED_ARGS[@]}"} || rc=1
+
+echo "== durability pytest lane =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m durability -p no:cacheprovider || rc=1
 
 echo "== planlint (lint-plan over benchmarks/) =="
 python lint_tpu.py lint-plan || rc=1
@@ -343,16 +360,21 @@ echo "== chaos pytest lane (fast units) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
     -m 'chaos and not slow' -p no:cacheprovider || rc=1
 
-echo "== chaos smoke (corrupt-latest + kill-mid-save trials) =="
+echo "== chaos smoke (corrupt-latest + kill-mid-save + spec-squat trials) =="
 # seed 0 = ckpt_bitflip (the ladder must recover from an older
 # generation charging zero restarts), seed 7 = kill_mid_save (resume
-# must match the uninterrupted twin exactly); replay exits non-zero
-# when any invariant is violated
+# must match the uninterrupted twin exactly), seed 13 = spec_torn_tmp
+# (a directory squatting on the old fixed-name spec tempfile — the
+# mkstemp publish must sail past it with zero restarts: the GL301
+# bugfix's end-to-end regression); replay exits non-zero when any
+# invariant is violated
 CHAOS_DIR="$(mktemp -d)"
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python chaos_tpu.py replay \
     --seed 0 --workdir "$CHAOS_DIR" >/dev/null || rc=1
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python chaos_tpu.py replay \
     --seed 7 --workdir "$CHAOS_DIR" >/dev/null || rc=1
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python chaos_tpu.py replay \
+    --seed 13 --workdir "$CHAOS_DIR" >/dev/null || rc=1
 rm -rf "$CHAOS_DIR"
 
 exit $rc

@@ -8,11 +8,16 @@ constant, the single wire_dtype seam, the two-phase communicator contract,
 loud failure paths), the interprocedural GL1xx SPMD-safety family
 (``spmd_rules.py``: verified ppermute permutation tables, no collectives
 under worker-divergent control flow, quantize-exactly-once wire lattice,
-static retrace prediction), and the GL2xx graftcontract family
+static retrace prediction), the GL2xx graftcontract family
 (``contracts.py``: the sync-budget prover against the committed
 ``sync_budget.json`` manifest, the journal-schema call-site verifier, and
-checkpoint-evolution coverage).  ``tests/test_analysis.py``,
-``tests/test_dataflow.py`` and ``tests/test_contracts.py`` run the same
+checkpoint-evolution coverage), and the GL3xx graftdur family
+(``durability.py``: the atomic-publish prover — every cross-process-watched
+file through the one ``utils.atomicio.atomic_publish`` seam — the
+single-writer journal + torn-tolerant-reader discipline, the best-effort
+IO seam inside root-marked loops, and thread-shared mutation proofs).
+``tests/test_analysis.py``, ``tests/test_dataflow.py``,
+``tests/test_contracts.py`` and ``tests/test_durability.py`` run the same
 engine in tier-1; this CLI is the interactive/CI surface.
 
 Examples

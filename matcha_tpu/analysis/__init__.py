@@ -11,6 +11,11 @@ Machine-checks the invariants earlier PRs established only as review lore:
 * ``contracts``  — GL201–GL203, the graftcontract family: the sync-budget
   prover (committed ``sync_budget.json`` manifest), the journal-schema
   call-site verifier, checkpoint-evolution coverage
+* ``durability`` — GL301–GL304, the graftdur family: host-plane
+  durability & concurrency — the atomic-publish prover (every
+  watched-path write through ``utils.atomicio.atomic_publish``), the
+  single-writer journal + torn-tolerant-reader proof, the best-effort IO
+  seam inside root-marked loops, and thread-shared mutation discipline
 * ``planlint``   — PL001–PL008, numeric verification of committed plan
   artifacts (``python lint_tpu.py lint-plan``)
 * ``sanitizer``  — the dynamic retrace (recompilation) detector
@@ -28,6 +33,11 @@ from .contracts import (
     collect_sync_sites,
     load_sync_budget,
     write_sync_budget,
+)
+from .durability import (
+    DURABILITY_RULES,
+    WATCHED_PATH_VOCABULARY,
+    parse_durability_markers,
 )
 from .engine import (
     LintSource,
@@ -58,6 +68,7 @@ __all__ = [
     "ALL_RULES",
     "CONTRACT_RULES",
     "CORE_RULES",
+    "DURABILITY_RULES",
     "LintSource",
     "PLAN_CHECKS",
     "Rule",
@@ -65,6 +76,7 @@ __all__ = [
     "SYNC_BUDGET_PATH",
     "TraceCount",
     "Violation",
+    "WATCHED_PATH_VOCABULARY",
     "check_single_trace",
     "collect_sources",
     "collect_sync_sites",
@@ -77,6 +89,7 @@ __all__ = [
     "lint_source",
     "load_baseline",
     "load_sync_budget",
+    "parse_durability_markers",
     "render_json",
     "render_plan_text",
     "render_text",

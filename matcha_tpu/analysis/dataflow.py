@@ -55,6 +55,8 @@ __all__ = [
     "FunctionSummary",
     "ModuleGraph",
     "NotFoldable",
+    "attribute_loads",
+    "attribute_stores",
     "collect_aliases",
     "collect_functions",
     "const_eval",
@@ -117,6 +119,47 @@ def dotted_name(node: ast.AST) -> Optional[str]:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return None
+
+
+def _flatten_target(target: ast.AST) -> Iterator[ast.AST]:
+    """Leaves of an assignment target (unpacks Tuple/List/Starred)."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _flatten_target(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _flatten_target(target.value)
+    else:
+        yield target
+
+
+def attribute_stores(node: ast.AST) -> Iterator[ast.Attribute]:
+    """Every ``ast.Attribute`` appearing as a *store* target under
+    ``node`` — plain/aug/annotated assignments, tuple unpacks included.
+    The write surface graftdur's GL304 (thread-shared mutation) audits:
+    an attribute store is the only way code reachable from two threads
+    mutates shared object state without a call."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        else:
+            continue
+        for target in targets:
+            for leaf in _flatten_target(target):
+                if isinstance(leaf, ast.Attribute):
+                    yield leaf
+
+
+def attribute_loads(node: ast.AST, base: Optional[str] = None
+                    ) -> Iterator[ast.Attribute]:
+    """Every ``ast.Attribute`` read under ``node``; ``base`` restricts to
+    loads whose value is that bare name (``base="self"`` → ``self.x``)."""
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load)
+                and (base is None or (isinstance(n.value, ast.Name)
+                                      and n.value.id == base))):
+            yield n
 
 
 def walk_values(node: ast.AST) -> Iterator[ast.AST]:

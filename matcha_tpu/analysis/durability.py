@@ -1,0 +1,706 @@
+"""graftdur — GL301–GL304, the host-plane durability & concurrency family.
+
+The serve/chaos PRs grew a production host-plane whose safety contracts —
+atomic publish of every watcher-read file, a single journal writer,
+best-effort telemetry IO, torn-line-tolerant readers, lock-disciplined
+thread sharing — were proven only *dynamically*, by seeded chaos
+campaigns sampling fault families.  Each rule here turns one discipline
+into a lint-time proof over the shared :mod:`dataflow` layer, so a
+regression fails CI by site and rule, not by a flaky campaign seed:
+
+========  ==================================================================
+GL301     atomic-publish prover: every write-mode ``open``/``fs.open`` on
+          a *watched path* (control documents, promotion manifests and
+          pointers, checkpoint sidecars, the supervisor spec, journal
+          rewrites — recognised by the name vocabulary below) must flow
+          through the ONE blessed ``utils.atomicio.atomic_publish`` seam
+          (mkstemp in the same directory → write → flush+fsync → rename).
+          Direct writes and fixed-name ``path + ".tmp"`` publishes are
+          flagged by name, and any second mkstemp+rename implementation
+          anywhere in the tree is itself a violation — the seam stays
+          singular.
+GL302     single-writer journal: exactly one root (the trainer lifetime's
+          Recorder, in ``obs/journal.py``) writes ``events.jsonl``.  Every
+          other write-mode open of a journal-named path is a violation;
+          supervisor-side ``append_journal_record`` sites must carry a
+          ``# graftdur: single-writer — reason`` annotation documenting
+          the between-lifetimes contract; and every journal *read* outside
+          ``obs/journal.py`` must ride the binary-per-line torn-tolerant
+          readers (``read_journal`` / ``salvage_journal`` /
+          ``read_journal_tail`` / ``count_journal_lines``) — a bare
+          text-mode ``open`` + ``json.loads(line)`` crashes on the torn
+          non-UTF-8 tail the repair path exists to forgive.
+GL303     best-effort IO seam: filesystem calls reachable from a
+          ``# graftcontract: root`` loop at epoch/batch/step scope (the
+          same loop-nesting analysis as GL201) must ride the
+          ``obs.bestio`` fs seam / ``BestEffortSink`` — a bare builtin
+          ``open`` write or ``os.replace`` there can hang the train loop
+          on a sick NFS mount with no deadline, no breaker, no fault
+          ledger entry.
+GL304     thread-shared mutation: attribute stores reachable from
+          ThreadingHTTPServer request-handler roots (``do_*`` methods),
+          and supervisor-root stores whose attributes are read by methods
+          *outside* the root's reach (the endpoint handler threads'
+          surface), must be lock-guarded (an enclosing ``with *lock*:``)
+          or annotated ``# graftdur: shared-state — reason``.
+========  ==================================================================
+
+Annotation grammar (same standalone-or-trailing attachment as graftlint
+suppressions and graftcontract markers)::
+
+    append_journal_record(  # graftdur: single-writer — between lifetimes
+        self.journal_path, "recovery", ...)
+
+    self._proc = None  # graftdur: shared-state — single GIL-atomic store
+
+Unlike GL201 there is no budget manifest: the annotation IS the audit
+artifact, and the committed ``graftlint_baseline.json`` stays empty.
+Like every ModuleGraph rule the reach is per translation unit
+(DESIGN.md §13).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .contracts import ENFORCED_SCOPES, _scope, parse_contract_markers
+from .dataflow import (attribute_loads, attribute_stores, dotted_name,
+                       module_graph)
+from .engine import LintSource, Rule, Violation, attach_to_next_code_line
+
+__all__ = [
+    "DURABILITY_RULES",
+    "WATCHED_PATH_VOCABULARY",
+    "parse_durability_markers",
+]
+
+#: the one blessed tempfile+rename implementation (GL301 exempts it) and
+#: the one blessed journal writer/reader module (GL302 exempts it)
+_BLESSED_PUBLISHER = "matcha_tpu/utils/atomicio.py"
+_JOURNAL_MODULE = "matcha_tpu/obs/journal.py"
+
+#: the watched-path vocabulary (DESIGN.md §25): name fragments that mark
+#: a path expression as *cross-process-watched* — another process reads
+#: the file by name, so a non-atomic write is a torn read waiting to
+#: happen.  Matched case-insensitively against the path expression's
+#: atoms (string constants, variable names, attribute names), with simple
+#: local assignments resolved first.
+WATCHED_PATH_VOCABULARY = (
+    "control.json",     # the operator→trainer control document
+    "events.jsonl",     # the run journal (rewrite path; appends are GL302)
+    "faults.json",      # the fault ledger plan-verify scores against
+    "manifest",         # promotion manifests + the MANIFEST serving pointer
+    "promoted",         # promoted-e*.npz candidate artifacts
+    "digest-",          # checkpoint integrity sidecars
+    "schedule-",        # checkpoint schedule-fingerprint sidecars
+    "membership-",      # checkpoint membership sidecars
+    "control_path",
+    "spec_path",        # the supervisor→trainer launch spec
+    "serve_spec",
+    "journal_path",
+    "sidecar",
+)
+_WATCHED_RE = re.compile(
+    "|".join(re.escape(w) for w in WATCHED_PATH_VOCABULARY), re.I)
+
+_SW_RE = re.compile(
+    r"#\s*graftdur:\s*single-writer\s*(?:—|–|-{1,2})\s*(.+)")
+_SS_RE = re.compile(
+    r"#\s*graftdur:\s*shared-state\s*(?:—|–|-{1,2})\s*(.+)")
+
+
+def parse_durability_markers(lines: Sequence[str]
+                             ) -> Tuple[Dict[int, str], Dict[int, str]]:
+    """``(single-writer line -> reason, shared-state line -> reason)`` —
+    attached via the shared standalone-or-trailing comment grammar."""
+    single_writer: Dict[int, str] = {}
+    shared_state: Dict[int, str] = {}
+    for lineno, line in enumerate(lines, 1):
+        for regex, table in ((_SW_RE, single_writer), (_SS_RE, shared_state)):
+            m = regex.search(line)
+            if m and m.group(1).strip():
+                table[attach_to_next_code_line(lines, lineno)] = \
+                    m.group(1).strip()
+    return single_writer, shared_state
+
+
+# =========================================================================
+# shared machinery: lexical scopes, path atoms, open-call classification
+# =========================================================================
+
+#: name -> [(assignment line, value expr)], ascending by line
+_Env = Dict[str, List[Tuple[int, ast.AST]]]
+
+
+def _scopes(tree: ast.AST) -> List[Tuple[_Env, List[ast.Call]]]:
+    """``(env, calls)`` per lexical scope (module + every def/lambda,
+    nested scopes inheriting the enclosing env).  ``env`` records every
+    simple assignment with its line, so a use site resolves to the latest
+    assignment *at or before it* — a fixed-name tempfile (``tmp =
+    spec_path + ".tmp"``) resolves at its ``open(tmp, "w")``, while a
+    reuse of the variable later in the function does not bleed back."""
+    results: List[Tuple[_Env, List[ast.Call]]] = []
+
+    def scope(body: List[ast.AST], inherited: _Env) -> None:
+        env: _Env = {k: list(v) for k, v in inherited.items()}
+        calls: List[ast.Call] = []
+        nested: List[ast.AST] = []
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                nested.append(n)
+                continue
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                env.setdefault(n.targets[0].id, []).append(
+                    (n.lineno, n.value))
+            if isinstance(n, ast.Call):
+                calls.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for entries in env.values():
+            entries.sort(key=lambda t: t[0])
+        results.append((env, calls))
+        for d in nested:
+            body2 = d.body if isinstance(d.body, list) else [d.body]
+            scope(body2, env)
+
+    scope(list(ast.iter_child_nodes(tree)), {})
+    return results
+
+
+def _resolve(env: _Env, name: str, use_line: int) -> Optional[ast.AST]:
+    """The value of ``name``'s latest assignment at or before
+    ``use_line`` (flow-sensitive enough for straight-line publish code)."""
+    best = None
+    for lineno, expr in env.get(name, ()):
+        if lineno <= use_line:
+            best = expr
+        else:
+            break
+    return best
+
+
+def _expr_atoms(expr: ast.AST, env: _Env, use_line: int,
+                depth: int = 3) -> List[str]:
+    """The name/string atoms of a path expression, with simple local
+    assignments resolved up to ``depth`` hops: string constants, variable
+    names, attribute names.  ``self.journal_path`` yields ``["self",
+    "journal_path"]``; ``tmp`` where ``tmp = control_path + ".tmp"``
+    yields ``["tmp", "control_path", ".tmp"]``."""
+    atoms: List[str] = []
+    seen: Set[int] = set()
+    stack: List[Tuple[ast.AST, int]] = [(expr, depth)]
+    while stack:
+        e, d = stack.pop()
+        for n in ast.walk(e):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                atoms.append(n.value)
+            elif isinstance(n, ast.Name):
+                atoms.append(n.id)
+                tgt = _resolve(env, n.id, use_line)
+                if d > 0 and tgt is not None and id(tgt) not in seen:
+                    seen.add(id(tgt))
+                    stack.append((tgt, d - 1))
+            elif isinstance(n, ast.Attribute):
+                atoms.append(n.attr)
+    return atoms
+
+
+def _watched(atoms: Sequence[str]) -> bool:
+    return bool(_WATCHED_RE.search(" ".join(atoms)))
+
+
+def _journalish(atoms: Sequence[str]) -> bool:
+    text = " ".join(atoms)
+    if "events.jsonl" in text or "journal_path" in text:
+        return True
+    # `jpath = self.journal.path` style: the receiver names the journal
+    return "journal" in atoms and ("path" in atoms or "jpath" in atoms)
+
+
+def _open_call(call: ast.Call
+               ) -> Optional[Tuple[bool, Optional[str], Optional[ast.AST]]]:
+    """``(is_builtin_open, mode_or_None, path_expr)`` for open-like calls
+    (builtin ``open``, ``fs.open``, ``get_fs().open``, ``os.fdopen``),
+    else None.  ``mode`` is None when not a string literal (``os.open``
+    flag ints, variables) — unprovable modes are not flagged."""
+    fn = dotted_name(call.func)
+    if fn is not None and fn == "os.open":
+        return None  # flags-int API, not a file-object open
+    leaf = None
+    if fn is not None:
+        leaf = fn.split(".")[-1]
+    elif isinstance(call.func, ast.Attribute):
+        leaf = call.func.attr  # get_fs().open(...) — non-Name receiver
+    if leaf not in ("open", "fdopen"):
+        return None
+    mode: Optional[str] = "r"
+    mode_arg = call.args[1] if len(call.args) >= 2 else next(
+        (kw.value for kw in call.keywords if kw.arg == "mode"), None)
+    if mode_arg is not None:
+        mode = mode_arg.value if (isinstance(mode_arg, ast.Constant)
+                                  and isinstance(mode_arg.value, str)) \
+            else None
+    path_expr = call.args[0] if call.args else None
+    return fn == "open", mode, path_expr
+
+
+def _is_write_mode(mode: Optional[str]) -> bool:
+    return mode is not None and any(c in mode for c in "wax+")
+
+
+def _call_leafs(calls: Sequence[ast.Call]) -> Set[str]:
+    out: Set[str] = set()
+    for c in calls:
+        fn = dotted_name(c.func)
+        if fn is not None:
+            out.add(fn.split(".")[-1])
+    return out
+
+
+# =========================================================================
+# GL301 — atomic-publish prover
+# =========================================================================
+
+class GL301AtomicPublish(Rule):
+    id = "GL301"
+    title = "watched-path write outside the blessed atomic_publish seam"
+    invariant = (
+        "Every cross-process-watched file — control documents, promotion "
+        "manifests and the MANIFEST pointer, promoted-* artifacts, "
+        "checkpoint digest/schedule/membership sidecars, the supervisor "
+        "spec, journal rewrites, faults.json — is published through the "
+        "ONE blessed seam, utils.atomicio.atomic_publish: mkstemp in the "
+        "same directory, write, flush+fsync, os.replace.  A direct "
+        "write-mode open on a watched-named path, a fixed-name `path + "
+        "\".tmp\"` publish (a shared mutable name any crashed sibling can "
+        "squat on — the chaos stale-tmp injector's target), or a second "
+        "mkstemp+rename implementation anywhere in the tree is a "
+        "violation by site name.  Reads and appends are out of scope "
+        "(appends are GL302's); chaos injectors that deliberately "
+        "manufacture torn state carry inline suppressions with reasons, "
+        "keeping the committed baseline empty."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        if source.path.endswith(_BLESSED_PUBLISHER):
+            return []
+        out: List[Violation] = []
+        for env, calls in _scopes(source.tree):
+            leafs = _call_leafs(calls)
+            if "mkstemp" in leafs and ("replace" in leafs
+                                       or "rename" in leafs):
+                anchor = next(c for c in calls
+                              if dotted_name(c.func) is not None
+                              and dotted_name(c.func).split(".")[-1]
+                              == "mkstemp")
+                out.append(self.hit(
+                    source, anchor,
+                    "hand-rolled tempfile+rename publish — the repo keeps "
+                    "exactly ONE implementation of the atomic-publish "
+                    "protocol (utils.atomicio.atomic_publish); route this "
+                    "write through it"))
+            for call in calls:
+                opened = _open_call(call)
+                if opened is None:
+                    continue
+                _, mode, path_expr = opened
+                if path_expr is None or not _is_write_mode(mode) \
+                        or (mode is not None and "a" in mode):
+                    continue
+                atoms = _expr_atoms(path_expr, env, call.lineno)
+                if not _watched(atoms):
+                    continue
+                if any(a.endswith(".tmp") for a in atoms):
+                    out.append(self.hit(
+                        source, call,
+                        "fixed-name `.tmp` publish of a watched path — a "
+                        "fixed tempfile name is a shared mutable name "
+                        "(collision- and stale-tmp-prone, the exact state "
+                        "the chaos stale-tmp injectors manufacture); "
+                        "publish via utils.atomicio.atomic_publish, which "
+                        "mkstemps a unique name in the same directory"))
+                else:
+                    out.append(self.hit(
+                        source, call,
+                        f"direct write-mode open({mode!r}) of a watched "
+                        f"path — a crash mid-write leaves a torn document "
+                        f"where a valid one existed; publish via "
+                        f"utils.atomicio.atomic_publish (mkstemp → write "
+                        f"→ flush+fsync → rename)"))
+        return out
+
+
+# =========================================================================
+# GL302 — single-writer journal + torn-tolerant readers
+# =========================================================================
+
+class GL302SingleWriterJournal(Rule):
+    id = "GL302"
+    title = "journal write outside the single-writer contract or bare read"
+    invariant = (
+        "events.jsonl has exactly one writer at a time: the trainer "
+        "lifetime's Recorder (obs/journal.py — Journal.flush and "
+        "append_journal_record are the only blessed write paths).  A "
+        "write-mode open of a journal-named path anywhere else is a "
+        "second writer; supervisor-side append_journal_record sites must "
+        "carry a `# graftdur: single-writer — reason` annotation stating "
+        "why they cannot race the trainer (the between-lifetimes "
+        "contract journal_control documents).  Readers are held to the "
+        "same discipline: every journal read outside obs/journal.py must "
+        "ride the binary-per-line torn-tolerant readers (read_journal / "
+        "salvage_journal / read_journal_tail / count_journal_lines) — a "
+        "bare text-mode open crashes with UnicodeDecodeError on the "
+        "non-UTF-8 torn tail that read_journal(repair=True) exists to "
+        "forgive, and a bare json.loads(line) loop crashes on the tail a "
+        "mid-append kill leaves."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        if source.path.endswith(_JOURNAL_MODULE):
+            return []
+        single_writer, _ = parse_durability_markers(source.lines)
+        out: List[Violation] = []
+        for env, calls in _scopes(source.tree):
+            for call in calls:
+                fn = dotted_name(call.func)
+                leaf = fn.split(".")[-1] if fn else None
+                if leaf == "append_journal_record":
+                    path_expr = call.args[0] if call.args else next(
+                        (kw.value for kw in call.keywords
+                         if kw.arg == "path"), None)
+                    if path_expr is not None and _journalish(
+                            _expr_atoms(path_expr, env, call.lineno)) \
+                            and call.lineno not in single_writer:
+                        out.append(self.hit(
+                            source, call,
+                            "journal append outside the trainer lifetime "
+                            "without a single-writer annotation — state "
+                            "why this site cannot race the Recorder "
+                            "(`# graftdur: single-writer — reason`; the "
+                            "journal has one writer at a time by "
+                            "contract)"))
+                    continue
+                opened = _open_call(call)
+                if opened is None:
+                    continue
+                _, mode, path_expr = opened
+                if path_expr is None or mode is None:
+                    continue
+                if not _journalish(_expr_atoms(path_expr, env,
+                                               call.lineno)):
+                    continue
+                if _is_write_mode(mode):
+                    out.append(self.hit(
+                        source, call,
+                        f"open({mode!r}) on the journal — a second "
+                        f"journal writer; the journal has exactly one "
+                        f"writer (the trainer lifetime's Recorder): "
+                        f"route through append_journal_record / "
+                        f"Journal.flush in obs/journal.py"))
+                else:
+                    out.append(self.hit(
+                        source, call,
+                        "bare read of the journal — a torn or non-UTF-8 "
+                        "tail (crash mid-append) crashes this reader; "
+                        "route through the torn-tolerant readers in "
+                        "obs/journal.py (read_journal / salvage_journal "
+                        "/ read_journal_tail / count_journal_lines)"))
+        return out
+
+
+# =========================================================================
+# GL303 — best-effort IO seam inside the loop
+# =========================================================================
+
+class GL303BestEffortIO(Rule):
+    id = "GL303"
+    title = "bare filesystem IO reachable inside a root-marked loop"
+    invariant = (
+        "Filesystem IO reachable from a `# graftcontract: root` function "
+        "at epoch/batch/step scope (GL201's loop-nesting analysis over "
+        "the same call graph) rides the obs.bestio seam: BestEffortSink "
+        "for telemetry/heartbeat writes (thread-with-deadline + breaker "
+        "+ fault ledger), fs.open/fs.replace for everything else (so the "
+        "chaos harness can inject ENOSPC and hung IO under it).  A bare "
+        "builtin open in a write mode, or a bare os.replace/os.rename, "
+        "reachable inside the loop can hang the train loop on a sick "
+        "mount with no deadline and no breaker — the exact failure the "
+        "io_hang chaos family injects.  Per translation unit like every "
+        "ModuleGraph rule; helpers in other modules are covered where "
+        "their own module declares a root."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        root_lines, _ = parse_contract_markers(source.lines)
+        if not root_lines:
+            return []
+        graph = module_graph(source)
+        roots = [(name, node) for name, nodes in graph.functions.items()
+                 for node in nodes
+                 if getattr(node, "lineno", None) in root_lines]
+        compiled_ids = {id(fn)
+                        for _, fn in graph.compiled_functions_cached()}
+        out: List[Violation] = []
+        seen_sites: Set[Tuple[int, str]] = set()
+
+        for root_name, root_node in roots:
+            visited: Set[Tuple[int, int, bool]] = set()
+
+            def classify(call: ast.Call) -> Optional[str]:
+                fn = dotted_name(call.func)
+                if fn in ("os.replace", "os.rename"):
+                    return fn
+                if fn == "open":  # builtin only: fs.open is the seam
+                    opened = _open_call(call)
+                    if opened is not None and _is_write_mode(opened[1]):
+                        return f"open(..., {opened[1]!r})"
+                return None
+
+            def scan_expr(expr: ast.AST, depth: int, ic: bool) -> None:
+                stack = [expr]
+                while stack:
+                    n = stack.pop()
+                    if isinstance(n, ast.Lambda):
+                        continue
+                    stack.extend(ast.iter_child_nodes(n))
+                    if not isinstance(n, ast.Call):
+                        continue
+                    label = classify(n)
+                    if label is not None \
+                            and _scope(depth, ic) in ENFORCED_SCOPES \
+                            and (n.lineno, label) not in seen_sites:
+                        seen_sites.add((n.lineno, label))
+                        out.append(self.hit(
+                            source, n,
+                            f"bare `{label}` at **{_scope(depth, ic)}** "
+                            f"scope, reachable from root `{root_name}` — "
+                            f"a hung write here stalls the train loop "
+                            f"with no deadline; ride BestEffortSink (for "
+                            f"telemetry/heartbeats) or the obs.bestio fs "
+                            f"seam (fs.open / fs.replace), or hoist it "
+                            f"out of the loop"))
+                    fn = dotted_name(n.func)
+                    if fn is not None:
+                        for defn in graph.resolve(fn):
+                            descend(defn, depth, ic)
+
+            def scan_body(stmts: List[ast.stmt], depth: int,
+                          ic: bool) -> None:
+                for st in stmts:
+                    if isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.ClassDef)):
+                        continue
+                    if isinstance(st, ast.For):
+                        scan_expr(st.iter, depth, ic)
+                        it = st.iter
+                        dict_iter = (isinstance(it, ast.Call)
+                                     and isinstance(it.func, ast.Attribute)
+                                     and it.func.attr in ("items", "keys",
+                                                          "values"))
+                        scan_body(st.body, depth + (0 if dict_iter else 1),
+                                  ic)
+                        scan_body(st.orelse, depth, ic)
+                    elif isinstance(st, ast.While):
+                        scan_expr(st.test, depth, ic)
+                        scan_body(st.body, depth + 1, ic)
+                        scan_body(st.orelse, depth, ic)
+                    elif isinstance(st, ast.If):
+                        scan_expr(st.test, depth, ic)
+                        scan_body(st.body, depth, ic)
+                        scan_body(st.orelse, depth, ic)
+                    elif isinstance(st, (ast.With, ast.AsyncWith)):
+                        for item in st.items:
+                            scan_expr(item.context_expr, depth, ic)
+                        scan_body(st.body, depth, ic)
+                    elif isinstance(st, ast.Try):
+                        scan_body(st.body, depth, ic)
+                        for h in st.handlers:
+                            scan_body(h.body, depth, ic)
+                        scan_body(st.orelse, depth, ic)
+                        scan_body(st.finalbody, depth, ic)
+                    else:
+                        scan_expr(st, depth, ic)
+
+            def descend(defn: ast.AST, depth: int, ic: bool) -> None:
+                key = (id(defn), min(depth, 3), ic)
+                if key in visited:
+                    return
+                visited.add(key)
+                ic = ic or id(defn) in compiled_ids
+                body = getattr(defn, "body", None)
+                if isinstance(body, list):
+                    scan_body(body, depth, ic)
+                elif body is not None:
+                    scan_expr(body, depth, ic)
+
+            descend(root_node, 0, False)
+        return out
+
+
+# =========================================================================
+# GL304 — thread-shared mutation
+# =========================================================================
+
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+                  "StreamRequestHandler"}
+
+
+def _reachable_defs(graph, start: Sequence[ast.AST]) -> List[ast.AST]:
+    """Defs reachable from ``start`` through the per-TU call graph
+    (alias-expanded, dotted names falling back to the leaf — so
+    ``endpoint._handle(self)`` reaches the ``_handle`` method)."""
+    seen = {id(n) for n in start}
+    order = list(start)
+    stack = list(start)
+    while stack:
+        d = stack.pop()
+        for n in ast.walk(d):
+            if isinstance(n, ast.Call):
+                fn = dotted_name(n.func)
+                if fn is None:
+                    continue
+                for t in graph.resolve(fn):
+                    if id(t) not in seen:
+                        seen.add(id(t))
+                        order.append(t)
+                        stack.append(t)
+    return order
+
+
+def _locky(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        name = n.id if isinstance(n, ast.Name) else (
+            n.attr if isinstance(n, ast.Attribute) else None)
+        if name is not None and "lock" in name.lower():
+            return True
+    return False
+
+
+def _guarded_stores(defn: ast.AST
+                    ) -> Iterator[Tuple[ast.Attribute, bool]]:
+    """``(attribute-store node, lock-guarded?)`` under ``defn`` — guarded
+    means an enclosing ``with`` whose context expression names a lock.
+    Nested defs/classes are skipped (they execute on their own call, and
+    reachability visits them separately)."""
+
+    def scan(node: ast.AST, guarded: bool) -> Iterator:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = guarded or any(_locky(i.context_expr)
+                                   for i in node.items)
+            for st in node.body:
+                yield from scan(st, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            for leaf in attribute_stores(node):
+                yield leaf, guarded
+        for child in ast.iter_child_nodes(node):
+            yield from scan(child, guarded)
+
+    body = getattr(defn, "body", [])
+    for st in (body if isinstance(body, list) else [body]):
+        yield from scan(st, False)
+
+
+class GL304ThreadSharedMutation(Rule):
+    id = "GL304"
+    title = "unguarded attribute mutation on thread-shared state"
+    invariant = (
+        "Objects reachable from BOTH the ThreadingHTTPServer request-"
+        "handler roots (do_* methods — each request runs on its own "
+        "thread) and the supervisor root (`# graftcontract: root`) are "
+        "effectively shared memory.  Two proofs per translation unit: "
+        "(a) code reachable from a handler class's do_* methods must not "
+        "store attributes at all unless lock-guarded or annotated — the "
+        "endpoint's handlers are read-only by design (they stat and read "
+        "files, never mutate the controller); (b) in a class whose root "
+        "method supervises (Controller.run), every `self.X` store "
+        "reachable from the root whose X is also READ by methods outside "
+        "the root's reach (status()/shutdown() — the handler threads' "
+        "entry points) must be lock-guarded (an enclosing `with *lock*:`) "
+        "or carry `# graftdur: shared-state — reason` stating the "
+        "GIL-atomicity / staleness-tolerance argument.  The annotation is "
+        "the audit artifact; the committed baseline stays empty."
+    )
+
+    def check(self, source: LintSource) -> List[Violation]:
+        _, shared_state = parse_durability_markers(source.lines)
+        root_lines, _ = parse_contract_markers(source.lines)
+        graph = module_graph(source)
+        out: List[Violation] = []
+        flagged: Set[Tuple[int, int]] = set()
+
+        def flag(store: ast.Attribute, guarded: bool, message: str) -> None:
+            key = (store.lineno, store.col_offset)
+            if guarded or store.lineno in shared_state or key in flagged:
+                return
+            flagged.add(key)
+            out.append(self.hit(source, store, message))
+
+        for cls in ast.walk(source.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [st for st in cls.body
+                       if isinstance(st, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))]
+            base_leafs = {b.attr if isinstance(b, ast.Attribute)
+                          else getattr(b, "id", None) for b in cls.bases}
+            # (a) request-handler reach: do_* roots, every store suspect
+            if base_leafs & _HANDLER_BASES:
+                handlers = [m for m in methods
+                            if m.name.startswith("do_")]
+                for defn in _reachable_defs(graph, handlers):
+                    for store, guarded in _guarded_stores(defn):
+                        flag(store, guarded,
+                             f"attribute store "
+                             f"`{dotted_name(store) or store.attr}` in "
+                             f"request-handler-reachable code — each "
+                             f"request runs on its own thread, so this "
+                             f"mutation races every other request and the "
+                             f"supervisor; make it read-only, guard with "
+                             f"a lock, or annotate `# graftdur: "
+                             f"shared-state — reason`")
+            # (b) supervisor root: stores read outside the root's reach
+            root_methods = [m for m in methods if m.lineno in root_lines]
+            if not root_methods:
+                continue
+            reachable = _reachable_defs(graph, root_methods)
+            rids = {id(d) for d in reachable}
+            outside = [m for m in methods
+                       if id(m) not in rids and m.name != "__init__"]
+            read_outside = {a.attr for m in outside
+                            for a in attribute_loads(m, base="self")}
+            for defn in reachable:
+                for store, guarded in _guarded_stores(defn):
+                    if not (isinstance(store.value, ast.Name)
+                            and store.value.id == "self"):
+                        continue
+                    if store.attr not in read_outside:
+                        continue
+                    readers = sorted(m.name for m in outside
+                                     if store.attr in
+                                     {a.attr for a in attribute_loads(
+                                         m, base="self")})
+                    flag(store, guarded,
+                         f"`self.{store.attr}` is mutated under the "
+                         f"supervisor root and read cross-thread by "
+                         f"{', '.join(readers)}() — guard with a lock or "
+                         f"annotate `# graftdur: shared-state — reason` "
+                         f"(single GIL-atomic store + staleness-tolerant "
+                         f"readers is an acceptable reason)")
+        return out
+
+
+DURABILITY_RULES: Tuple[Rule, ...] = (
+    GL301AtomicPublish(),
+    GL302SingleWriterJournal(),
+    GL303BestEffortIO(),
+    GL304ThreadSharedMutation(),
+)

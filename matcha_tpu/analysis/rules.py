@@ -413,9 +413,11 @@ CORE_RULES: Tuple[Rule, ...] = (
 # engine and the dataflow layer) can never cycle back into a
 # half-initialized module
 from .contracts import CONTRACT_RULES  # noqa: E402
+from .durability import DURABILITY_RULES  # noqa: E402
 from .spmd_rules import SPMD_RULES  # noqa: E402
 
-ALL_RULES: Tuple[Rule, ...] = CORE_RULES + SPMD_RULES + CONTRACT_RULES
+ALL_RULES: Tuple[Rule, ...] = (CORE_RULES + SPMD_RULES + CONTRACT_RULES
+                               + DURABILITY_RULES)
 
 
 def rules_by_id(ids: Optional[Sequence[str]] = None) -> Tuple[Rule, ...]:

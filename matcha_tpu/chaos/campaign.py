@@ -40,6 +40,7 @@ from .injectors import (
     stale_checkpoint_tempfile,
     tear_journal_tail,
     torn_control_tempfile,
+    torn_spec_tempfile,
 )
 from .invariants import check_invariants, final_epoch_row
 from .taps import ENV_KILL
@@ -64,6 +65,7 @@ FAMILIES = (
     "io_enospc",           # ENOSPC on heartbeat writes
     "io_slow",             # hung/slow heartbeat writes (past the deadline)
     "clock_skew",          # skewed heartbeat wall clock
+    "spec_torn_tmp",       # directory squatting on spec.json.tmp
 )
 
 #: training seed shared by every trial and twin — variety comes from the
@@ -202,7 +204,8 @@ def _twin_row(workdir: str, epochs: int, promote: bool,
 
 
 _DURABLE = ("ckpt_bitflip", "ckpt_missing_file", "ckpt_stale_tmp",
-            "journal_torn_tail", "journal_midstream", "control_torn_tmp")
+            "journal_torn_tail", "journal_midstream", "control_torn_tmp",
+            "spec_torn_tmp")
 
 
 def _inject_durable(spec: FaultSpec, ctl, rng: random.Random) -> Dict:
@@ -224,6 +227,8 @@ def _inject_durable(spec: FaultSpec, ctl, rng: random.Random) -> Dict:
         return tear_journal_tail(ctl.journal_path, rng)
     if family == "journal_midstream":
         return corrupt_journal_midstream(ctl.journal_path, rng)
+    if family == "spec_torn_tmp":
+        return torn_spec_tempfile(ctl.spec_path)
     return torn_control_tempfile(ctl.control_path)
 
 

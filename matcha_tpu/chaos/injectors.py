@@ -24,7 +24,7 @@ from typing import List
 __all__ = ["checkpoint_files", "bitflip_checkpoint",
            "delete_checkpoint_file", "stale_checkpoint_tempfile",
            "tear_journal_tail", "corrupt_journal_midstream",
-           "torn_control_tempfile"]
+           "torn_control_tempfile", "torn_spec_tempfile"]
 
 
 def checkpoint_files(ckpt_dir: str, step: int) -> List[str]:
@@ -77,6 +77,8 @@ def stale_checkpoint_tempfile(ckpt_dir: str, step: int) -> dict:
     crash between a sidecar's tmp-write and its ``os.replace`` leaves."""
     path = os.path.join(os.path.abspath(ckpt_dir),
                         f"digest-{int(step)}.json.tmp")
+    # graftlint: disable=GL301 — injector: writes the stale tmp a crashed
+    # publish leaves, the state atomic_publish exists to avoid
     with open(path, "w") as f:
         f.write('{"step": %d, "files": {"trunca' % int(step))
     return {"injector": "stale_checkpoint_tempfile", "path": path}
@@ -86,6 +88,8 @@ def tear_journal_tail(journal_path: str, rng: random.Random) -> dict:
     """Truncate the journal mid-final-line — the crash-during-append
     state ``read_journal(repair=True)`` must drop (and resume must
     journal as a ``recovery``/``repair``)."""
+    # graftlint: disable=GL302 — injector: raw byte surgery on a dead
+    # run's journal, not a reader racing a live writer
     with open(journal_path, "rb") as f:
         data = f.read()
     if not data.strip():
@@ -95,6 +99,8 @@ def tear_journal_tail(journal_path: str, rng: random.Random) -> dict:
     # keep at least 1 byte and lose at least the newline + 1 byte, so the
     # remaining tail can never parse as a complete record
     cut = rng.randrange(2, max(len(last), 3))
+    # graftlint: disable=GL301,GL302 — injector: deliberately tears the
+    # journal tail between lifetimes; the "second writer" IS the fault
     with open(journal_path, "wb") as f:
         f.write(data[:len(data) - cut])
     return {"injector": "tear_journal_tail", "cut_bytes": cut,
@@ -106,6 +112,8 @@ def corrupt_journal_midstream(journal_path: str,
     """Overwrite bytes inside an interior line — corruption ``repair=True``
     cannot drop (it only forgives the tail): the salvage-prefix-and-
     quarantine path must handle it."""
+    # graftlint: disable=GL302 — injector: raw byte surgery on a dead
+    # run's journal, not a reader racing a live writer
     with open(journal_path, "rb") as f:
         data = f.read()
     lines = data.splitlines(keepends=True)
@@ -119,6 +127,8 @@ def corrupt_journal_midstream(journal_path: str,
     span = min(max(len(line) // 3, 4), len(line) - 2)
     start = rng.randrange(1, len(line) - span)
     lines[idx] = line[:start] + b"\xff" * span + line[start + span:]
+    # graftlint: disable=GL301,GL302 — injector: plants the mid-stream
+    # corruption the salvage path must quarantine; the fault is the point
     with open(journal_path, "wb") as f:
         f.write(b"".join(lines))
     return {"injector": "corrupt_journal_midstream", "line": idx,
@@ -134,7 +144,24 @@ def torn_control_tempfile(control_path: str, version: int = 99) -> dict:
     tmp = control_path + ".tmp"
     os.makedirs(os.path.dirname(os.path.abspath(control_path)),
                 exist_ok=True)
+    # graftlint: disable=GL301 — injector: fabricates the half-written
+    # tempfile a kill mid-publish leaves, to prove the watcher ignores it
     with open(tmp, "w") as f:
         f.write(torn[:len(torn) // 2])
     return {"injector": "torn_control_tempfile", "path": tmp,
             "version": int(version)}
+
+
+def torn_spec_tempfile(spec_path: str) -> dict:
+    """Squat a *directory* on the fixed name ``spec_path + ".tmp"``.
+
+    The regression the GL301 bugfix is pinned against: the controller's
+    spec publish used to write to exactly this fixed name, so anything
+    squatting on it — a crashed sibling's leftover, an operator mkdir, a
+    stale artifact — wedged every later relaunch with IsADirectoryError.
+    The mkstemp-based ``atomic_publish`` never touches a fixed name, so a
+    relaunch must now sail past the squatter untouched."""
+    tmp = spec_path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(spec_path)), exist_ok=True)
+    os.mkdir(tmp)  # a directory: unlinkable-by-open, worst-case squatter
+    return {"injector": "torn_spec_tempfile", "path": tmp}

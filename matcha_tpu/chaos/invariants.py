@@ -42,6 +42,7 @@ EXPECTED_RESTARTS = {
     "kill_epoch_boundary": 1, "kill_mid_save": 1, "kill_mid_promote": 1,
     "kill_mid_control": 1,
     "io_enospc": 0, "io_slow": 0, "clock_skew": 0,
+    "spec_torn_tmp": 0,
 }
 
 #: family → (scope, action) of the recovery event the journal must hold;
@@ -59,6 +60,9 @@ EXPECTED_RECOVERY = {
     "io_enospc": ("io", "degraded"),
     "io_slow": ("io", "degraded"),
     "clock_skew": None,
+    # the squatter must simply be sailed past (mkstemp publish): no
+    # recovery record, no restart — the relaunch just works
+    "spec_torn_tmp": None,
 }
 
 

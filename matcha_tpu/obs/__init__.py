@@ -95,6 +95,7 @@ from .journal import (
     append_journal_record,
     epoch_series,
     make_event,
+    count_journal_lines,
     read_journal,
     read_journal_tail,
     resolve_journal_path,
@@ -128,6 +129,7 @@ __all__ = [
     "fleet_verdict",
     "capacity_report",
     "chip_peaks",
+    "count_journal_lines",
     "compose_predicted_rho",
     "critical_path_report",
     "drift_report",

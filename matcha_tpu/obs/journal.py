@@ -37,7 +37,8 @@ __all__ = ["SCHEMA_VERSION", "ACCEPTED_VERSIONS", "EVENT_KINDS",
            "FAULT_KINDS", "V2_KINDS", "V3_KINDS", "V4_KINDS", "V5_KINDS",
            "V6_KINDS", "V7_KINDS", "KIND_MIN_VERSION", "REQUIRED_FIELDS",
            "make_event", "validate_event", "Journal", "read_journal",
-           "salvage_journal", "read_journal_tail", "resolve_journal_path",
+           "salvage_journal", "read_journal_tail", "count_journal_lines",
+           "resolve_journal_path",
            "latest_per_epoch", "epoch_series", "append_journal_record"]
 
 #: v2 (ISSUE 8) adds only new kinds — ``compile`` (the cost ledger's
@@ -257,13 +258,15 @@ class Journal:
             self._flushed = 0
         pending = list(events[self._flushed:])
         if rewrite or not os.path.exists(self.path):
-            # truncate + full write: atomic via tmp so a crash mid-dump
-            # cannot leave half a journal where a whole one existed
-            tmp = self.path + ".tmp"
-            with fs.open(tmp, "w") as f:
+            # truncate + full write: atomic via the blessed publish seam
+            # so a crash mid-dump cannot leave half a journal where a
+            # whole one existed
+            from ..utils.atomicio import atomic_publish
+
+            def _dump_all(f, events=tuple(events)):
                 for e in events:
                     f.write(_dump_line(e))
-            fs.replace(tmp, self.path)
+            atomic_publish(self.path, _dump_all, prefix=".events.")
         elif pending:
             with fs.open(self.path, "a") as f:
                 for e in pending:
@@ -408,6 +411,23 @@ def read_journal_tail(path: str, n: int, block: int = 65536) -> List[dict]:
                 f"{path}: malformed journal line in tail window ({e})"
             ) from e
     return events[-n:]
+
+
+def count_journal_lines(path: str) -> int:
+    """Non-blank line count of a journal, torn-tail tolerant.
+
+    The cheap "how many records made it to disk" probe (recorder
+    flush-accounting, tests).  Reads in **binary**: a crash mid-append can
+    leave a non-UTF-8 partial tail, and a text-mode count would raise
+    UnicodeDecodeError on exactly the file this probe exists to size up.
+    A torn tail still counts as one line — callers compare against an
+    expected floor, not an exact decode."""
+    count = 0
+    with open(path, "rb") as f:
+        for line in f:
+            if line.strip():
+                count += 1
+    return count
 
 
 def resolve_journal_path(source: str) -> str:

@@ -32,8 +32,9 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 from typing import Dict, List, Optional, Tuple
+
+from ..utils.atomicio import atomic_publish
 
 __all__ = [
     "CONTROL_BASENAME",
@@ -117,24 +118,14 @@ def load_control(path: str) -> Tuple[Optional[dict], List[str]]:
 
 
 def write_control(path: str, doc: dict) -> None:
-    """Publish a control document atomically (temp + ``os.replace`` in
-    the same directory — the only rename POSIX makes atomic)."""
+    """Publish a control document atomically through the one blessed
+    publish seam (``utils.atomicio.atomic_publish``, DESIGN.md §25)."""
     problems = validate_control(doc)
     if problems:
         raise ValueError("refusing to write an invalid control document: "
                          + "; ".join(problems))
-    directory = os.path.dirname(os.path.abspath(path))
-    os.makedirs(directory, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(prefix=".control.", dir=directory)
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(doc, f, indent=2, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    atomic_publish(path, json.dumps(doc, indent=2, sort_keys=True) + "\n",
+                   prefix=".control.")
 
 
 def journal_control(journal_path: str, *, action: str, applied: bool,
@@ -145,6 +136,8 @@ def journal_control(journal_path: str, *, action: str, applied: bool,
     time by contract."""
     from ..obs.journal import append_journal_record
 
+    # graftdur: single-writer — supervisor-side append, by contract only
+    # between trainer lifetimes (documented above): no live Recorder races
     append_journal_record(journal_path, "control", action=action,
                           applied=applied, reason=reason, epoch=epoch,
                           **extra)

@@ -36,6 +36,7 @@ import sys
 import time
 from typing import Dict, Optional
 
+from ..utils.atomicio import atomic_publish
 from .control import (
     CONTROL_BASENAME,
     RESTART_EXIT,
@@ -132,8 +133,6 @@ class Controller:
 
             if latest_step(self.ckpt_dir) is not None:
                 config["resume"] = self.ckpt_dir
-        os.makedirs(os.path.dirname(os.path.abspath(self.spec_path)),
-                    exist_ok=True)
         spec = {
             "config": config,
             "control_path": self.control_path,
@@ -143,13 +142,19 @@ class Controller:
             "promote_keep": self.serve.promote_keep,
             "eval_batch": self.serve.eval_batch,
         }
-        tmp = self.spec_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(spec, f, indent=2, sort_keys=True)
-        os.replace(tmp, self.spec_path)
+        # through the blessed publish seam: the old fixed-name
+        # ``spec_path + ".tmp"`` was a shared mutable name — a crash (or
+        # any sibling artifact) squatting on it wedged every later
+        # publish, the exact state the chaos ``spec_torn_tmp`` family
+        # injects.  mkstemp never collides.
+        atomic_publish(self.spec_path,
+                       json.dumps(spec, indent=2, sort_keys=True) + "\n",
+                       prefix=".spec.")
 
     def _launch(self) -> subprocess.Popen:
         self._write_spec()
+        # graftdur: shared-state — single GIL-atomic int store; status()
+        # readers tolerate a one-poll-stale count
         self.lifetimes += 1
         # the package may be running straight out of a checkout (not
         # installed): make the child resolve `-m matcha_tpu...` from the
@@ -214,10 +219,14 @@ class Controller:
         credits = min(delta // self.serve.refill_epochs, self.restarts_used)
         if credits <= 0:
             return
+        # graftdur: shared-state — single GIL-atomic int store; status()
+        # readers tolerate a one-poll-stale budget
         self.restarts_used -= credits
         self._refill_base += credits * self.serve.refill_epochs
         from ..obs.journal import append_journal_record
 
+        # graftdur: single-writer — run() calls this only after wait():
+        # the trainer lifetime (the journal's one writer) has exited
         append_journal_record(
             self.journal_path, "recovery", scope="budget", action="refill",
             reason=f"{delta} clean checkpointed epoch(s) since the last "
@@ -245,6 +254,8 @@ class Controller:
         from ..train.checkpoint import quarantine_step
 
         qpath = quarantine_step(self.ckpt_dir, progress)
+        # graftdur: single-writer — run() calls this only after wait():
+        # the trainer lifetime (the journal's one writer) has exited
         append_journal_record(
             self.journal_path, "recovery", scope="checkpoint",
             action="quarantine",
@@ -264,9 +275,15 @@ class Controller:
         clean completion)."""
         sleep = self.serve.backoff
         while True:
+            # graftdur: shared-state — single reference store; shutdown()
+            # and status() snapshot it once and tolerate a stale view
+            # (worst case: terminate() an already-exited process, a no-op)
             self._proc = self._launch()
             rc = self._proc.wait()
+            # graftdur: shared-state — single reference store (see above)
             self._proc = None
+            # graftdur: shared-state — single GIL-atomic store; status()
+            # readers tolerate a one-poll-stale exit code
             self.last_exit = rc
             if self._stopping or rc == 0:
                 return 0 if rc in (0, RESTART_EXIT) else rc
@@ -282,6 +299,8 @@ class Controller:
             progress = self._progress()
             self._maybe_refill(progress)
             self._maybe_escalate(rc, progress, time.monotonic())
+            # graftdur: shared-state — single GIL-atomic int store;
+            # status() readers tolerate a one-poll-stale budget
             self.restarts_used += 1
             if self.restarts_used > self.serve.restart_budget:
                 journal_control(

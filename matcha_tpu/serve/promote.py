@@ -33,10 +33,11 @@ import dataclasses
 import hashlib
 import json
 import os
-import tempfile
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..utils.atomicio import atomic_publish
 
 __all__ = [
     "MANIFEST_BASENAME",
@@ -141,23 +142,12 @@ def _file_sha256(path: str) -> str:
 
 
 def _atomic_json(path: str, obj: dict) -> None:
-    directory = os.path.dirname(os.path.abspath(path))
-    fd, tmp = tempfile.mkstemp(prefix=".manifest.", dir=directory)
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(obj, f, indent=2, sort_keys=True)
-            f.write("\n")
-        # chaos barrier (no-op unless armed): dying HERE leaves a stale
-        # tempfile next to the still-valid previous pointer — the
-        # torn-publish state readers must never see half of
-        from ..chaos.taps import maybe_kill
-
-        maybe_kill("mid_promote")
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    # barrier="mid_promote": the chaos kill tap (no-op unless armed) fires
+    # between write and rename — dying there leaves a stale tempfile next
+    # to the still-valid previous pointer, the torn-publish state readers
+    # must never see half of
+    atomic_publish(path, json.dumps(obj, indent=2, sort_keys=True) + "\n",
+                   prefix=".manifest.", barrier="mid_promote")
 
 
 def write_candidate(serving_dir: str, epoch: int, step: int,
@@ -168,16 +158,8 @@ def write_candidate(serving_dir: str, epoch: int, step: int,
     os.makedirs(serving_dir, exist_ok=True)
     params_file = f"promoted-e{epoch:05d}.npz"
     params_path = os.path.join(serving_dir, params_file)
-    fd, tmp = tempfile.mkstemp(prefix=".promoted.", dir=serving_dir)
-    os.close(fd)
-    try:
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, params_path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    atomic_publish(params_path, lambda f: np.savez(f, **arrays),
+                   mode="wb", prefix=".promoted.")
     manifest = {
         "format": MANIFEST_FORMAT,
         "epoch": int(epoch),

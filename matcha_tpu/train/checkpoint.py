@@ -27,6 +27,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from ..utils.atomicio import atomic_publish
 from .state import TrainState
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "restore_with_fallback",
@@ -199,27 +200,20 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
 
     maybe_kill("mid_save")
     # integrity sidecar: the content digest the restore ladder verifies
-    # before trusting this generation — written atomically, like the rest
+    # before trusting this generation — published through the blessed
+    # atomic seam, like every other watcher-read file (DESIGN.md §25)
     digest = checkpoint_digest(directory, epoch)
-    dpath = _digest_path(directory, epoch)
-    tmp = dpath + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(digest, f)
-    os.replace(tmp, dpath)
+    atomic_publish(_digest_path(directory, epoch), json.dumps(digest),
+                   prefix=".digest.")
     if schedule is not None:
-        # atomic write: a crash mid-dump must not leave a truncated sidecar
-        # that later fails json.load during a legitimate resume
-        path = _sidecar_path(directory, epoch)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(schedule_fingerprint(schedule), f)
-        os.replace(tmp, path)
+        # atomic publish: a crash mid-dump must not leave a truncated
+        # sidecar that later fails json.load during a legitimate resume
+        atomic_publish(_sidecar_path(directory, epoch),
+                       json.dumps(schedule_fingerprint(schedule)),
+                       prefix=".schedule.")
     if membership is not None:
-        path = _membership_sidecar_path(directory, epoch)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(membership, f)
-        os.replace(tmp, path)
+        atomic_publish(_membership_sidecar_path(directory, epoch),
+                       json.dumps(membership), prefix=".membership.")
     # prune sidecars whose step orbax (max_to_keep) has garbage-collected:
     # on directory reuse a stale schedule-<epoch>.json (or the membership
     # twin) from a prior run could otherwise be read against a later

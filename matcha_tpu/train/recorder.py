@@ -43,8 +43,9 @@ from typing import Dict, List
 import numpy as np
 
 from ..obs.bestio import BestEffortSink, get_fs
-from ..obs.journal import (FAULT_KINDS, Journal, make_event,
-                           read_journal, salvage_journal)
+from ..obs.journal import (FAULT_KINDS, Journal, count_journal_lines,
+                           make_event, read_journal, salvage_journal)
+from ..utils.atomicio import atomic_publish
 
 __all__ = ["Recorder"]
 
@@ -193,8 +194,9 @@ class Recorder:
             # mid-file — schedule a full rewrite from memory instead
             try:
                 self.events = read_journal(jpath, repair=True)
-                with open(jpath) as f:
-                    disk_lines = sum(1 for line in f if line.strip())
+                # binary-tolerant count: a crash mid-append can leave a
+                # non-UTF-8 tail that a text-mode iteration would choke on
+                disk_lines = count_journal_lines(jpath)
             except ValueError:
                 # mid-stream corruption: repair cannot drop an interior
                 # line without rewriting history — salvage the clean
@@ -328,10 +330,8 @@ class Recorder:
         if faults:
             # atomic like the checkpoint sidecar: a crash mid-dump must not
             # leave truncated JSON for the verifier to choke on
-            tmp = path + ".tmp"
-            with fs.open(tmp, "w") as f:
-                json.dump({"events": faults}, f, indent=1)
-            fs.replace(tmp, path)
+            atomic_publish(path, json.dumps({"events": faults}, indent=1),
+                           prefix=".faults.")
         elif os.path.exists(path):
             # a fault-free rerun into the same folder must not leave a
             # previous run's ledger behind: plan-verify would silently score

@@ -1,8 +1,11 @@
-"""Shared utilities: metrics, timing, profiling, backend pinning."""
+"""Shared utilities: metrics, timing, profiling, backend pinning,
+atomic publication."""
 
+from .atomicio import atomic_publish
 from .metrics import AverageMeter, cross_entropy_loss, top_k_accuracy
 from .platform import pin_platform, user_cache_dir
 from .profiling import annotate, device_span, trace
 
-__all__ = ["AverageMeter", "annotate", "cross_entropy_loss", "device_span",
-           "pin_platform", "user_cache_dir", "top_k_accuracy", "trace"]
+__all__ = ["AverageMeter", "annotate", "atomic_publish",
+           "cross_entropy_loss", "device_span", "pin_platform",
+           "user_cache_dir", "top_k_accuracy", "trace"]

@@ -1,0 +1,83 @@
+"""atomic_publish — the ONE tempfile+rename publish seam in the repo.
+
+Every file that another process *watches* — control documents, promotion
+manifests and the serving pointer, checkpoint sidecars, the supervisor
+spec, journal rewrites — must be published through this helper, never
+through a hand-rolled ``open(path, "w")`` or a fixed-name ``path +
+".tmp"`` dance.  The contract (DESIGN.md §25):
+
+1. ``mkstemp`` in the *same directory* as the target (rename is only
+   atomic within a filesystem, and mkstemp never collides — a fixed
+   tempfile name is a shared mutable name any crashed sibling can squat
+   on);
+2. write the full payload;
+3. ``flush`` + ``fsync`` so the rename can never expose an empty or
+   partially-persisted file after a power cut;
+4. ``os.replace`` onto the target — readers see the old document or the
+   new one, never half of either.
+
+IO rides the ``obs.bestio`` fs seam, so the chaos harness can inject
+ENOSPC/hung writes under any publish without monkeypatching call sites.
+graftdur's GL301 (analysis/durability.py) statically proves that every
+watched-path write routes through here and that no second tempfile+rename
+implementation creeps back in.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Union
+
+__all__ = ["atomic_publish"]
+
+#: payloads: text, bytes, or a writer callback ``f -> None`` for payloads
+#: that stream themselves (np.savez archives, journal line loops)
+Payload = Union[str, bytes, Callable]
+
+
+def atomic_publish(path: str, data: Payload, *, fsync: bool = True,
+                   mode: str = "w", prefix: str = None,
+                   barrier: str = None) -> None:
+    """Atomically publish ``data`` at ``path`` (see module docstring).
+
+    ``data`` may be ``str``/``bytes`` (written verbatim) or a callable
+    taking the open file object.  ``mode`` must be a write mode (``"w"``
+    or ``"wb"``).  ``prefix`` names the tempfile family (default derives
+    from the target's basename); temp names always end in ``.tmp`` so the
+    checkpoint root's stale-temp sweep recognises crash leftovers.
+    ``barrier`` optionally arms a chaos kill tap between write and rename
+    — the torn-publish window readers must never observe.
+    """
+    if mode not in ("w", "wb"):
+        raise ValueError(f"atomic_publish requires a write mode, got {mode!r}")
+    path = os.fspath(path)
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    from ..obs.bestio import get_fs
+
+    fs = get_fs()
+    fd, tmp = tempfile.mkstemp(
+        prefix=prefix or "." + os.path.basename(path) + ".",
+        suffix=".tmp", dir=directory)
+    os.close(fd)
+    try:
+        with fs.open(tmp, mode) as f:
+            if callable(data):
+                data(f)
+            elif isinstance(data, bytes):
+                f.write(data)
+            else:
+                f.write(str(data))
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        if barrier is not None:
+            from ..chaos.taps import maybe_kill
+
+            maybe_kill(barrier)
+        fs.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

@@ -436,12 +436,15 @@ def test_shipped_tree_is_clean():
 
 def test_rules_cover_the_documented_set():
     # core syntactic family + the interprocedural SPMD family (ISSUE 6) +
-    # the graftcontract family (ISSUE 15); tests/test_dataflow.py
-    # exercises GL101–GL104 and tests/test_contracts.py GL201–GL203
+    # the graftcontract family (ISSUE 15) + the graftdur family (ISSUE 20);
+    # tests/test_dataflow.py exercises GL101–GL104,
+    # tests/test_contracts.py GL201–GL203, tests/test_durability.py
+    # GL301–GL304
     assert [r.id for r in ALL_RULES] == [
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
         "GL101", "GL102", "GL103", "GL104",
-        "GL201", "GL202", "GL203"]
+        "GL201", "GL202", "GL203",
+        "GL301", "GL302", "GL303", "GL304"]
     for r in ALL_RULES:
         assert r.title and r.invariant  # lint_tpu --list-rules has substance
 

@@ -151,13 +151,14 @@ def _assert_stamp_schema(data, where):
         assert {"rule", "path", "line", "col", "message"} <= set(v), (
             f"{where}: malformed violation entry {v}")
     rule_ids = {r["id"] for r in data["rules"]}
-    # schema v2 (ISSUE 15): a full-run stamp must carry the graftcontract
-    # family next to the core + SPMD families — a stamp without GL201 was
-    # produced by a pre-contract tree and is not evidence for this one
-    assert {"GL001", "GL101", "GL201"} <= rule_ids, (
+    # schema v2 (ISSUE 15) added the graftcontract family; v3 (ISSUE 20)
+    # adds graftdur — a full-run stamp must carry all four families; a
+    # stamp without GL201 or GL301 was produced by an older tree and is
+    # not evidence for this one
+    assert {"GL001", "GL101", "GL201", "GL301"} <= rule_ids, (
         f"{where}: stamp rule set {sorted(rule_ids)} is missing the core, "
-        f"SPMD, or graftcontract family — it was not produced by the full "
-        f"default run")
+        f"SPMD, graftcontract, or graftdur family — it was not produced "
+        f"by the full default run")
     assert data["clean"] == (not data["violations"]), where
 
 
